@@ -1,0 +1,27 @@
+"""Test harness configuration.
+
+Runs the whole suite on CPU with 8 virtual XLA devices — the TPU-native
+analogue of the reference's "torchrun on one box" testing story (SURVEY.md §4):
+multi-device DP/FSDP behavior is exercised without a real pod.
+
+XLA_FLAGS must be set before the first backend is instantiated; the platform
+is forced via jax.config (robust even when a site hook pre-registered an
+accelerator plugin at interpreter start).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_report_header(config):
+    return f"jax devices: {jax.device_count()} x {jax.devices()[0].platform}"

@@ -1,0 +1,256 @@
+"""Unit tests for the model layer (SURVEY.md §4 implication (a)).
+
+Covers the pure functions against closed forms — including the literal
+``rotate_half`` example from the reference's learning guide — plus forward
+shape/loss checks mirroring the reference's __main__ smoke test
+(``/root/reference/src/models/gpt.py:492-508``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models import (
+    GPT,
+    GPTConfig,
+    RMSNorm,
+    apply_rotary_pos_emb,
+    count_parameters,
+    generate,
+    rope_tables,
+    rotate_half,
+)
+
+
+def tiny_config(**kw):
+    defaults = dict(
+        vocab_size=128,
+        hidden_size=32,
+        num_layers=2,
+        num_heads=4,
+        max_seq_len=64,
+        dropout=0.0,
+        attention_dropout=0.0,
+        dtype="float32",
+    )
+    defaults.update(kw)
+    return GPTConfig(**defaults)
+
+
+def init_model(config, batch=2, seq=16, seed=0):
+    model = GPT(config)
+    rng = jax.random.PRNGKey(seed)
+    ids = jax.random.randint(rng, (batch, seq), 0, config.vocab_size)
+    params = model.init(rng, ids)["params"]
+    return model, params, ids
+
+
+class TestRotateHalf:
+    def test_learning_guide_example(self):
+        # Reference docs: rotate_half([1,2,3,4]) == [-3,-4,1,2]
+        # (/root/reference/docs/LEARNING_GUIDE.md:24)
+        x = jnp.array([1.0, 2.0, 3.0, 4.0])
+        np.testing.assert_allclose(rotate_half(x), jnp.array([-3.0, -4.0, 1.0, 2.0]))
+
+    def test_involution_sign(self):
+        x = jnp.arange(8.0)
+        np.testing.assert_allclose(rotate_half(rotate_half(x)), -x)
+
+
+class TestRMSNorm:
+    def test_closed_form(self):
+        x = jnp.array([[3.0, 4.0]])
+        out = RMSNorm().apply(
+            {"params": {"weight": jnp.ones(2)}}, x
+        )
+        # rms = sqrt(mean([9,16]) + eps) ~ sqrt(12.5)
+        expected = x / np.sqrt(12.5 + 1e-6)
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    def test_scale_applied(self):
+        x = jnp.ones((1, 4))
+        out = RMSNorm().apply({"params": {"weight": 2.0 * jnp.ones(4)}}, x)
+        np.testing.assert_allclose(out, 2.0 * jnp.ones((1, 4)), rtol=1e-5)
+
+
+class TestRoPE:
+    def test_tables_match_reference_construction(self):
+        # Reference gpt.py:76-93: freqs = t ⊗ inv_freq, emb = concat(freqs, freqs)
+        dim, seq = 8, 16
+        cos, sin = rope_tables(seq, dim, base=10000.0)
+        inv_freq = 1.0 / (10000.0 ** (np.arange(0, dim, 2) / dim))
+        freqs = np.outer(np.arange(seq), inv_freq)
+        emb = np.concatenate([freqs, freqs], axis=-1)
+        np.testing.assert_allclose(cos, np.cos(emb), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(sin, np.sin(emb), rtol=1e-4, atol=1e-6)
+
+    def test_norm_preserved(self):
+        # Rotation must preserve vector norms.
+        rng = jax.random.PRNGKey(1)
+        q = jax.random.normal(rng, (2, 16, 4, 8))
+        k = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 4, 8))
+        cos, sin = rope_tables(16, 8)
+        q_rot, k_rot = apply_rotary_pos_emb(q, k, cos, sin)
+        np.testing.assert_allclose(
+            jnp.linalg.norm(q_rot, axis=-1), jnp.linalg.norm(q, axis=-1), rtol=1e-5
+        )
+
+    def test_position_zero_identity(self):
+        q = jax.random.normal(jax.random.PRNGKey(3), (1, 4, 2, 8))
+        cos, sin = rope_tables(4, 8)
+        q_rot, _ = apply_rotary_pos_emb(q, q, cos, sin)
+        np.testing.assert_allclose(q_rot[:, 0], q[:, 0], rtol=1e-5)
+
+    def test_relative_property(self):
+        # <rope(q, m), rope(k, n)> depends only on m - n.
+        dim = 16
+        cos, sin = rope_tables(32, dim)
+        q = jax.random.normal(jax.random.PRNGKey(4), (dim,))
+        k = jax.random.normal(jax.random.PRNGKey(5), (dim,))
+
+        def rot(x, pos):
+            x4 = x[None, None, None, :]
+            return (x4 * cos[pos] + rotate_half(x4) * sin[pos])[0, 0, 0]
+
+        d1 = jnp.dot(rot(q, 5), rot(k, 3))
+        d2 = jnp.dot(rot(q, 12), rot(k, 10))
+        np.testing.assert_allclose(d1, d2, rtol=1e-4)
+
+
+class TestGPTForward:
+    def test_shapes_and_finite_loss(self):
+        config = tiny_config()
+        model, params, ids = init_model(config)
+        logits, loss = model.apply({"params": params}, ids, labels=ids)
+        assert logits.shape == (2, 16, config.vocab_size)
+        assert logits.dtype == jnp.float32
+        assert loss is not None and np.isfinite(float(loss))
+        # Random init → loss near ln(vocab_size).
+        assert abs(float(loss) - np.log(config.vocab_size)) < 1.0
+
+    def test_no_labels_no_loss(self):
+        config = tiny_config()
+        model, params, ids = init_model(config)
+        logits, loss = model.apply({"params": params}, ids)
+        assert loss is None
+
+    def test_param_count_matches_analytic(self):
+        config = tiny_config()
+        _, params, _ = init_model(config)
+        assert count_parameters(params) == config.num_parameters()
+
+    def test_param_count_gpt2_small_exact(self):
+        config = GPTConfig.gpt2_small()
+        h, i, v, l = 768, 3072, 50257, 12
+        expected = v * h + l * (4 * h * h + 3 * h * i + 2 * h) + h
+        assert config.num_parameters() == expected
+
+    def test_weight_tying(self):
+        # Tied embeddings: no separate lm_head parameter exists.
+        config = tiny_config()
+        _, params, _ = init_model(config)
+        assert "lm_head" not in params
+        assert "embed_tokens" in params
+
+    def test_deterministic_eval(self):
+        config = tiny_config(dropout=0.1, attention_dropout=0.1)
+        model, params, ids = init_model(config)
+        l1, _ = model.apply({"params": params}, ids)
+        l2, _ = model.apply({"params": params}, ids)
+        np.testing.assert_array_equal(l1, l2)
+
+    def test_dropout_varies_in_train_mode(self):
+        config = tiny_config(dropout=0.5)
+        model, params, ids = init_model(config)
+        out1, _ = model.apply(
+            {"params": params}, ids, train=True,
+            rngs={"dropout": jax.random.PRNGKey(1)},
+        )
+        out2, _ = model.apply(
+            {"params": params}, ids, train=True,
+            rngs={"dropout": jax.random.PRNGKey(2)},
+        )
+        assert not np.allclose(out1, out2)
+
+    def test_flash_matches_reference_path(self):
+        # use_flash_attention toggles the fused path; numerics must agree with
+        # the manual path (the reference keeps both, gpt.py:199-234).
+        c_ref = tiny_config(use_flash_attention=False)
+        c_flash = tiny_config(use_flash_attention=True)
+        model_ref, params, ids = init_model(c_ref)
+        model_flash = GPT(c_flash)
+        l1, _ = model_ref.apply({"params": params}, ids)
+        l2, _ = model_flash.apply({"params": params}, ids)
+        np.testing.assert_allclose(l1, l2, atol=2e-4, rtol=2e-4)
+
+    def test_gradient_checkpointing_same_forward(self):
+        config = tiny_config()
+        config_remat = tiny_config(gradient_checkpointing=True)
+        model, params, ids = init_model(config)
+        model_remat = GPT(config_remat)
+        l1, loss1 = model.apply({"params": params}, ids, labels=ids)
+        l2, loss2 = model_remat.apply({"params": params}, ids, labels=ids)
+        np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-5)
+
+    def test_remat_same_gradients(self):
+        config = tiny_config()
+        config_remat = tiny_config(gradient_checkpointing=True)
+        model, params, ids = init_model(config)
+        model_remat = GPT(config_remat)
+
+        def loss_fn(m):
+            def f(p):
+                return m.apply({"params": p}, ids, labels=ids)[1]
+            return f
+
+        g1 = jax.grad(loss_fn(model))(params)
+        g2 = jax.grad(loss_fn(model_remat))(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+            g1, g2,
+        )
+
+    def test_loss_shift_semantics(self):
+        # Loss must be next-token: first label position never scored; feeding
+        # labels == inputs on a 2-token repeat sequence gives low loss only if
+        # shifting is right. Cross-check against a hand-rolled computation.
+        config = tiny_config()
+        model, params, ids = init_model(config)
+        logits, loss = model.apply({"params": params}, ids, labels=ids)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1)[..., 0]
+        np.testing.assert_allclose(float(loss), float(-picked.mean()), rtol=1e-5)
+
+
+class TestGenerate:
+    def test_shapes_and_prompt_preserved(self):
+        config = tiny_config()
+        _, params, ids = init_model(config, batch=2, seq=8)
+        out = generate(
+            params, jax.random.PRNGKey(0), ids,
+            config=config, max_new_tokens=5, temperature=1.0, top_k=10,
+        )
+        assert out.shape == (2, 13)
+        np.testing.assert_array_equal(out[:, :8], ids)
+        assert (out >= 0).all() and (out < config.vocab_size).all()
+
+    def test_topk_one_is_greedy(self):
+        config = tiny_config()
+        _, params, ids = init_model(config, batch=1, seq=4)
+        out1 = generate(params, jax.random.PRNGKey(0), ids,
+                        config=config, max_new_tokens=6, top_k=1)
+        out2 = generate(params, jax.random.PRNGKey(7), ids,
+                        config=config, max_new_tokens=6, top_k=1)
+        np.testing.assert_array_equal(out1, out2)
+
+    def test_long_prompt_cropped(self):
+        # Prompt + new tokens beyond max_seq_len: the window crop (reference
+        # gpt.py:469) keeps shapes legal.
+        config = tiny_config(max_seq_len=16)
+        _, params, _ = init_model(config, batch=1, seq=14)
+        ids = jax.random.randint(jax.random.PRNGKey(9), (1, 14), 0, config.vocab_size)
+        out = generate(params, jax.random.PRNGKey(0), ids,
+                       config=config, max_new_tokens=8, top_k=5)
+        assert out.shape == (1, 22)
+        np.testing.assert_array_equal(out[:, :14], ids)

@@ -1,0 +1,15 @@
+"""tpu_trainer — a TPU-native distributed LLM training framework.
+
+Brand-new JAX/XLA/Pallas/GSPMD re-design with the capabilities of the
+reference PyTorch/NCCL trainer (``zhc180/distributed-llm-trainer``): LLaMA-style
+GPT model, DDP and FSDP(ZeRO-2/3) training, dummy/TinyStories/OpenWebText data,
+Orbax checkpointing, inference CLI. See SURVEY.md at the repo root for the
+component-by-component parity map.
+"""
+
+__version__ = "0.1.0"
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT, count_parameters, generate
+
+__all__ = ["GPTConfig", "GPT", "count_parameters", "generate", "__version__"]

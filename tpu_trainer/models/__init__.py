@@ -1,0 +1,28 @@
+from tpu_trainer.models.config import GPTConfig, dtype_of
+from tpu_trainer.models.gpt import (
+    GPT,
+    MLP,
+    CausalSelfAttention,
+    RMSNorm,
+    TransformerBlock,
+    apply_rotary_pos_emb,
+    count_parameters,
+    generate,
+    rope_tables,
+    rotate_half,
+)
+
+__all__ = [
+    "GPTConfig",
+    "dtype_of",
+    "GPT",
+    "MLP",
+    "CausalSelfAttention",
+    "RMSNorm",
+    "TransformerBlock",
+    "apply_rotary_pos_emb",
+    "count_parameters",
+    "generate",
+    "rope_tables",
+    "rotate_half",
+]

@@ -1,0 +1,145 @@
+"""Model configuration.
+
+TPU-native re-design of the reference model config
+(``/root/reference/src/models/config.py:6-102``). Differences from the reference,
+by design:
+
+- Frozen (hashable) dataclass so it can be a static argument to ``jax.jit``.
+- ``num_parameters()`` is exact for the *actual* architecture (RoPE + RMSNorm +
+  SwiGLU + tied embeddings). The reference's estimate counts a learned positional
+  embedding the model does not have and 4 LayerNorm params/layer where RMSNorm has
+  one weight vector (reference ``config.py:81-102`` — SURVEY.md §2.1 b7).
+- ``activation`` defaults to ``"silu"`` and is honored; the reference declares
+  ``"gelu"`` but hardcodes SiLU in the MLP (``gpt.py:280`` — SURVEY.md §2.1 b9).
+- Adds the compute/parameter dtype policy (TPU bf16-compute / fp32-params recipe),
+  replacing torch autocast (reference ``ddp_trainer.py:115-156``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def dtype_of(name: str):
+    """Map a dtype name ('float32' | 'bfloat16' | 'float16') to a jnp dtype."""
+    return _DTYPES[name]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    """Configuration for the GPT model (defaults = GPT-2 124M / "small").
+
+    Architecturally LLaMA-style — RMSNorm, RoPE, SwiGLU, no biases, pre-norm,
+    tied embeddings — with GPT-2's vocabulary, mirroring the reference
+    (``/root/reference/src/models/gpt.py``; SURVEY.md §2.1 b9).
+    """
+
+    # Model architecture (reference config.py:13-19)
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # defaults to 4 * hidden_size
+    max_seq_len: int = 1024
+
+    # Regularization (reference config.py:21-23)
+    dropout: float = 0.1
+    attention_dropout: float = 0.1
+
+    # Initialization (reference config.py:25-26)
+    initializer_range: float = 0.02
+
+    # Activation — honored here (SiLU), unlike the reference's dead field.
+    activation: str = "silu"
+
+    # RoPE base frequency (reference gpt.py:76 hardcodes 10000)
+    rope_theta: float = 10000.0
+
+    # Optimization flags (reference config.py:30-32)
+    use_flash_attention: bool = False
+    gradient_checkpointing: bool = False
+
+    # TPU dtype policy: compute dtype for activations/matmuls; params and the
+    # softmax/loss accumulations stay float32.
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            object.__setattr__(self, "intermediate_size", 4 * self.hidden_size)
+        assert self.hidden_size % self.num_heads == 0, (
+            f"hidden_size ({self.hidden_size}) must be divisible by "
+            f"num_heads ({self.num_heads})"
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def compute_dtype(self):
+        return dtype_of(self.dtype)
+
+    @property
+    def params_dtype(self):
+        return dtype_of(self.param_dtype)
+
+    # --- Size presets (reference config.py:41-79) ------------------------------
+
+    @classmethod
+    def gpt2_small(cls, **overrides) -> "GPTConfig":
+        """GPT-2 124M-class configuration."""
+        return cls(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+                   **overrides)
+
+    @classmethod
+    def gpt2_medium(cls, **overrides) -> "GPTConfig":
+        """GPT-2 355M-class configuration."""
+        return cls(vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16,
+                   **overrides)
+
+    @classmethod
+    def gpt2_large(cls, **overrides) -> "GPTConfig":
+        """GPT-2 774M-class configuration."""
+        return cls(vocab_size=50257, hidden_size=1280, num_layers=36, num_heads=20,
+                   **overrides)
+
+    @classmethod
+    def gpt2_xl(cls, **overrides) -> "GPTConfig":
+        """GPT-2 1.5B-class configuration."""
+        return cls(vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25,
+                   **overrides)
+
+    @classmethod
+    def preset(cls, name: str, **overrides) -> "GPTConfig":
+        presets = {
+            "small": cls.gpt2_small,
+            "medium": cls.gpt2_medium,
+            "large": cls.gpt2_large,
+            "xl": cls.gpt2_xl,
+        }
+        if name not in presets:
+            raise ValueError(f"unknown model size {name!r}; choose from {sorted(presets)}")
+        return presets[name](**overrides)
+
+    def num_parameters(self) -> int:
+        """Exact parameter count of the actual model.
+
+        embed (tied with lm_head): V*H
+        per layer: attention 4*H^2 (q/k/v/o, no bias) + SwiGLU 3*H*I
+                   + 2 RMSNorm weight vectors (2*H)
+        final RMSNorm: H
+        """
+        h, i = self.hidden_size, self.intermediate_size
+        embed = self.vocab_size * h
+        per_layer = 4 * h * h + 3 * h * i + 2 * h
+        return embed + self.num_layers * per_layer + h

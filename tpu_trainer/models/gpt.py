@@ -1,0 +1,335 @@
+"""TPU-native GPT model in Flax.
+
+Re-designs the reference model (``/root/reference/src/models/gpt.py``) as an
+idiomatic JAX/Flax module. Capability parity, component by component:
+
+- RMSNorm (reference ``gpt.py:22-67``) — float32 accumulation, bf16 out.
+- RoPE (``gpt.py:70-147``) — tables recomputed on the fly, never stored in the
+  checkpoint (the reference persists them as buffers — SURVEY.md §2.1 b8).
+- Causal self-attention (``gpt.py:150-242``) — both the fused/"flash" path and
+  the manual jnp path, selected by ``config.use_flash_attention``.
+- SwiGLU MLP (``gpt.py:245-283``).
+- Pre-norm transformer block (``gpt.py:286-316``) — the unit of rematerialization
+  (gradient checkpointing) and of FSDP sharding granularity, mirrored here as
+  the unit of ``nn.remat`` + ``nn.scan``.
+- GPT with tied embeddings (``gpt.py:319-455``), normal(initializer_range) init
+  (``gpt.py:350-386``), shifted cross-entropy loss (``gpt.py:450-453``).
+- Autoregressive generation with temperature/top-k and context-window cropping
+  (``gpt.py:457-484``) — here as a jit-compiled ``lax.fori_loop``.
+
+Architectural choices that are TPU-first rather than translations:
+
+- Layers are stacked via ``nn.scan`` (one traced block, parameters carry a
+  leading ``[num_layers, ...]`` axis). XLA compiles the block once; the stacked
+  layout is also what GSPMD shards best.
+- Attention uses the BSHD layout ``[batch, seq, heads, head_dim]`` end to end;
+  no transposes around the kernel.
+- The model is parallelism-blind (the reference's single most load-bearing
+  property — SURVEY.md §1): sharding is applied entirely outside via GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.ops.attention import flash_attention, reference_attention
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square layer norm (reference ``gpt.py:22-67``).
+
+    ``x * rsqrt(mean(x^2) + eps) * weight`` with float32 accumulation.
+    """
+
+    eps: float = 1e-6
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        weight = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        rms = jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (x32 * rms * weight).astype(self.dtype)
+
+
+def rope_tables(
+    seq_len: int, dim: int, base: float = 10000.0
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables, shape ``[seq_len, dim]``.
+
+    Matches the reference cache construction (``gpt.py:76-93``): inverse
+    frequencies over even indices, angles tiled as ``concat(freqs, freqs)``.
+    Computed fresh under jit (constant-folded by XLA) — never checkpointed.
+    """
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    """``[a, b, c, d] -> [-c, -d, a, b]`` (reference ``gpt.py:100-117``)."""
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_pos_emb(
+    q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Rotate q/k by position (reference ``gpt.py:120-147``).
+
+    q, k: ``[batch, seq, heads, head_dim]``; cos, sin: ``[seq, head_dim]``.
+    Applied in float32, cast back to the inputs' dtype.
+    """
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_rot = q32 * cos + rotate_half(q32) * sin
+    k_rot = k32 * cos + rotate_half(k32) * sin
+    return q_rot.astype(q.dtype), k_rot.astype(k.dtype)
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention (reference ``gpt.py:150-242``)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        b, s, _ = x.shape
+        dense = functools.partial(
+            nn.Dense,
+            features=cfg.hidden_size,
+            use_bias=False,
+            dtype=cfg.compute_dtype,
+            param_dtype=cfg.params_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+        )
+        q = dense(name="q_proj")(x)
+        k = dense(name="k_proj")(x)
+        v = dense(name="v_proj")(x)
+
+        # [b, s, h*d] -> [b, s, heads, head_dim] (BSHD; no BHSD transpose on TPU)
+        q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+
+        cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
+        q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+        needs_rng = cfg.attention_dropout > 0.0 and not deterministic
+        dropout_rng = self.make_rng("dropout") if needs_rng else None
+        attn_fn = flash_attention if cfg.use_flash_attention else reference_attention
+        out = attn_fn(
+            q, k, v,
+            dropout_rate=cfg.attention_dropout,
+            deterministic=deterministic,
+            dropout_rng=dropout_rng,
+        )
+
+        out = out.reshape(b, s, cfg.hidden_size)
+        out = dense(name="o_proj")(out)
+        out = nn.Dropout(rate=cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class MLP(nn.Module):
+    """SwiGLU feed-forward (reference ``gpt.py:245-283``):
+    ``down(silu(gate(x)) * up(x))`` + dropout."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        dense = functools.partial(
+            nn.Dense,
+            use_bias=False,
+            dtype=cfg.compute_dtype,
+            param_dtype=cfg.params_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+        )
+        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+        up = dense(cfg.intermediate_size, name="up_proj")(x)
+        act = {"silu": nn.silu, "gelu": nn.gelu}[cfg.activation]
+        x = act(gate) * up
+        x = dense(cfg.hidden_size, name="down_proj")(x)
+        return nn.Dropout(rate=cfg.dropout)(x, deterministic=deterministic)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm block with two residuals (reference ``gpt.py:286-316``).
+
+    Written in scan form: ``__call__(carry, _) -> (carry, None)`` so a single
+    traced block is iterated ``num_layers`` times by ``nn.scan``.
+    """
+
+    config: GPTConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, _unused=None):
+        cfg = self.config
+        residual = x
+        h = RMSNorm(dtype=cfg.compute_dtype, name="input_layernorm")(x)
+        h = CausalSelfAttention(cfg, name="attention")(h, self.deterministic)
+        x = residual + h
+
+        residual = x
+        h = RMSNorm(dtype=cfg.compute_dtype, name="post_attention_layernorm")(x)
+        h = MLP(cfg, name="mlp")(h, self.deterministic)
+        x = residual + h
+        return x, None
+
+
+class GPT(nn.Module):
+    """GPT for causal language modeling (reference ``gpt.py:319-484``)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jax.Array,
+        attention_mask: Optional[jax.Array] = None,
+        labels: Optional[jax.Array] = None,
+        train: bool = False,
+    ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        """Forward pass.
+
+        ``attention_mask`` is accepted for API parity but — exactly like the
+        reference (``gpt.py:203`` passes ``attn_mask=None``; SURVEY.md §2.1 b3)
+        — semantics are causal-only.
+
+        Returns ``(logits [b, s, vocab] float32, loss | None)``.
+        """
+        cfg = self.config
+        embed = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            dtype=cfg.compute_dtype,
+            param_dtype=cfg.params_dtype,
+            name="embed_tokens",
+        )
+        x = embed(input_ids)
+
+        block = TransformerBlock
+        if cfg.gradient_checkpointing:
+            # Remat per block — the reference's activation-checkpointing unit
+            # (gpt.py:440-444, fsdp_trainer.py:312-328).
+            block = nn.remat(block, prevent_cse=False)
+        layers = nn.scan(
+            block,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.num_layers,
+        )
+        x, _ = layers(cfg, deterministic=not train, name="layers")(x, None)
+
+        x = RMSNorm(dtype=cfg.compute_dtype, name="norm")(x)
+        # Weight tying (reference gpt.py:342): logits via the embedding matrix.
+        logits = embed.attend(x).astype(jnp.float32)
+
+        loss = None
+        if labels is not None:
+            # Shifted next-token cross entropy (reference gpt.py:450-453), mean
+            # over batch * (seq - 1) positions, computed in float32.
+            shift_logits = logits[:, :-1, :]
+            shift_labels = labels[:, 1:]
+            loss = jnp.mean(
+                optax_softmax_cross_entropy(shift_logits, shift_labels)
+            )
+        return logits, loss
+
+
+def optax_softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Integer-label softmax cross entropy without the optax import cycle."""
+    logits = logits.astype(jnp.float32)
+    log_z = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return log_z - label_logits
+
+
+def count_parameters(params) -> int:
+    """Total parameter count (reference ``gpt.py:487-489``)."""
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "max_new_tokens", "temperature", "top_k")
+)
+def generate(
+    params,
+    rng: jax.Array,
+    input_ids: jax.Array,
+    *,
+    config: GPTConfig,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    top_k: int = 50,
+) -> jax.Array:
+    """Autoregressive sampling (reference ``gpt.py:457-484``), fully jitted.
+
+    Same semantics as the reference: crop context to ``max_seq_len``, divide
+    logits by ``temperature``, keep the top-k logits (when ``top_k > 0``),
+    sample from the resulting distribution, append. The reference's Python
+    loop with a growing tensor becomes a fixed-size buffer + ``lax.fori_loop``
+    (static shapes; one compile per (prompt_len, max_new_tokens)).
+
+    The reference recomputes the full forward each step with no KV cache
+    (``infer.py`` hot loop, SURVEY.md §3.5); a windowed full forward matches
+    that exactly. KV-cached decode is a planned fast path.
+    """
+    model = GPT(config)
+    b, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+    window = min(total, config.max_seq_len)
+
+    buf = jnp.zeros((b, total), dtype=input_ids.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, input_ids, (0, 0))
+
+    def body(i, carry):
+        buf, rng = carry
+        # Window of the last `window` tokens ending just before position i.
+        start = jnp.clip(i - window, 0, total - window)
+        ids = jax.lax.dynamic_slice(buf, (0, start), (b, window))
+        logits, _ = model.apply({"params": params}, ids)
+        pos = i - 1 - start  # index of the newest real token inside the window
+        last = jax.lax.dynamic_slice(logits, (0, pos, 0), (b, 1, logits.shape[-1]))[:, 0]
+        last = last / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(last, min(top_k, last.shape[-1]))[0][:, -1:]
+            last = jnp.where(last < kth, -jnp.inf, last)
+        rng, sub = jax.random.split(rng)
+        nxt = jax.random.categorical(sub, last).astype(buf.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+        return buf, rng
+
+    buf, _ = jax.lax.fori_loop(prompt_len, total, body, (buf, rng))
+    return buf
+
+
+if __name__ == "__main__":
+    # Smoke test mirroring the reference __main__ block (gpt.py:492-508).
+    config = GPTConfig.gpt2_small(dropout=0.0, attention_dropout=0.0)
+    model = GPT(config)
+    rng = jax.random.PRNGKey(0)
+    input_ids = jax.random.randint(rng, (2, 128), 0, config.vocab_size)
+    params = model.init(rng, input_ids)["params"]
+
+    print(f"Model config: {config}")
+    print(f"Estimated parameters: {config.num_parameters():,}")
+    print(f"Actual parameters: {count_parameters(params):,}")
+
+    logits, loss = model.apply({"params": params}, input_ids, labels=input_ids)
+    print(f"Logits shape: {logits.shape}")
+    print(f"Loss: {float(loss):.4f}")

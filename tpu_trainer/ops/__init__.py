@@ -1,0 +1,7 @@
+from tpu_trainer.ops.attention import (
+    causal_mask,
+    flash_attention,
+    reference_attention,
+)
+
+__all__ = ["causal_mask", "flash_attention", "reference_attention"]

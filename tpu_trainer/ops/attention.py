@@ -1,0 +1,92 @@
+"""Attention ops: jnp reference path + fused ("flash") path.
+
+Mirrors the two attention paths of the reference
+(``/root/reference/src/models/gpt.py:199-234``):
+
+- ``reference_attention`` — the manual path (``gpt.py:230-234``): QK^T/sqrt(d)
+  → causal mask → float32 softmax → dropout → @V. Kept as the numerics oracle
+  for the fused kernel, exactly as the reference keeps its manual branch.
+- ``flash_attention`` — the fused path (``gpt.py:199-206`` calls torch's
+  ``scaled_dot_product_attention``). Here this dispatches to the Pallas TPU
+  kernel (``tpu_trainer.ops.flash``) when available, falling back to XLA's
+  fused attention otherwise.
+
+All functions take ``q, k, v`` of shape ``[batch, seq, num_heads, head_dim]``
+(BSHD layout — the natural layout for TPU, avoiding the transpose the reference
+does for torch's BHSD convention).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def causal_mask(seq_len: int) -> jax.Array:
+    """Boolean [seq, seq] mask, True where attention is allowed (lower tri)."""
+    return jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Manual causal attention (reference ``gpt.py:230-234``).
+
+    float32 softmax for stability (the reference passes ``dtype=torch.float32``
+    to softmax), dropout applied to the attention weights.
+    """
+    _, s, _, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = causal_mask(s)
+    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(scores.dtype).min)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Fused causal attention (reference flash path, ``gpt.py:199-206``).
+
+    Dispatches to the Pallas TPU kernel when running on TPU; otherwise uses
+    XLA's fused dot-product attention. When attention dropout is active
+    (training), falls back to the manual path so dropout semantics match the
+    reference exactly.
+    """
+    if dropout_rate > 0.0 and not deterministic:
+        # Fused kernels don't implement attention-weight dropout yet; match the
+        # reference's training semantics via the manual path.
+        return reference_attention(
+            q, k, v,
+            dropout_rate=dropout_rate,
+            deterministic=deterministic,
+            dropout_rng=dropout_rng,
+        )
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        try:
+            from tpu_trainer.ops import flash  # local import: pallas only on TPU
+
+            return flash.flash_attention(q, k, v, causal=True)
+        except ImportError:
+            pass
+    return jax.nn.dot_product_attention(q, k, v, is_causal=True)
