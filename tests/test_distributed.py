@@ -1,0 +1,152 @@
+"""Multi-device DP/FSDP tests on the fake-8-device CPU mesh
+(SURVEY.md §4 implications (c) and (d)).
+
+The reference can only "test" distributed behavior by launching torchrun
+locally; here the same coverage is an actual assertion suite: DP and every
+FSDP mode produce step-for-step identical losses to single-device at equal
+global batch, and every param/opt leaf lands on its expected sharding.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_trainer.data.dummy import DummyDataLoader
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel.mesh import FSDP_AXIS, MeshConfig, make_mesh
+from tpu_trainer.parallel.sharding import canonical_strategy, fsdp_spec
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+
+MODEL = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                  max_seq_len=16, dropout=0.0, attention_dropout=0.0)
+TRAIN = TrainingConfig(batch_size=2, max_seq_len=16, gradient_accumulation_steps=2,
+                       max_steps=100, warmup_steps=5, learning_rate=3e-3,
+                       mixed_precision="fp32", seed=0)
+
+
+def make_trainer(mesh_cfg, strategy, train_cfg=TRAIN, devices=None):
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    return Trainer(MODEL, train_cfg, ParallelConfig(mesh_cfg, strategy), mesh=mesh)
+
+
+def run(trainer, n_steps=5, data_seed=11):
+    state = trainer.init_state()
+    dl = DummyDataLoader(trainer.global_batch_size, 16, 128,
+                         num_batches=n_steps, seed=data_seed)
+    losses = []
+    for batch in dl:
+        state, m = trainer.train_step(state, trainer.put_batch(batch))
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+@pytest.fixture(scope="module")
+def single_device_run():
+    # Equal global batch: 1 device x bs 16 == 8 devices x bs 2 (x accum 2).
+    cfg = TrainingConfig(batch_size=16, max_seq_len=16,
+                         gradient_accumulation_steps=2, max_steps=100,
+                         warmup_steps=5, learning_rate=3e-3,
+                         mixed_precision="fp32", seed=0)
+    trainer = make_trainer(MeshConfig(data=1, fsdp=1), "replicated", cfg,
+                           devices=jax.devices()[:1])
+    return run(trainer)
+
+
+class TestEquivalence:
+    """DP/FSDP must be placement, not math: losses equal single-device."""
+
+    def check(self, mesh_cfg, strategy, single_device_run, atol=1e-5):
+        ref_state, ref_losses = single_device_run
+        state, losses = run(make_trainer(mesh_cfg, strategy))
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-5, atol=atol)
+        # Final params identical too (gathered automatically by comparison).
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            state.params, ref_state.params,
+        )
+
+    def test_dp8_equals_single(self, single_device_run):
+        self.check(MeshConfig(data=8, fsdp=1), "replicated", single_device_run)
+
+    def test_fsdp_zero3_equals_single(self, single_device_run):
+        self.check(MeshConfig(data=1, fsdp=8), "FULL_SHARD", single_device_run)
+
+    def test_fsdp_zero2_equals_single(self, single_device_run):
+        self.check(MeshConfig(data=1, fsdp=8), "SHARD_GRAD_OP", single_device_run)
+
+    def test_hybrid_shard_equals_single(self, single_device_run):
+        # HYBRID_SHARD: broken in the reference (docstring only), real here.
+        self.check(MeshConfig(data=2, fsdp=4), "zero3", single_device_run)
+
+
+class TestShardingSpecs:
+    """SURVEY.md §4(d): every param/opt leaf matches its expected sharding."""
+
+    def leaf_specs(self, tree):
+        return jax.tree_util.tree_map(lambda x: x.sharding.spec, tree)
+
+    def test_zero3_params_sharded(self):
+        trainer = make_trainer(MeshConfig(data=1, fsdp=8), "zero3")
+        state = trainer.init_state()
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state.params):
+            spec = leaf.sharding.spec
+            expected = fsdp_spec(leaf.shape, 8)
+            assert tuple(spec) == tuple(expected), (path, spec, expected)
+            # Everything in this tiny model has a divisible dim → sharded.
+            assert any(a == FSDP_AXIS for a in spec), path
+
+    def test_zero3_opt_state_sharded(self):
+        trainer = make_trainer(MeshConfig(data=1, fsdp=8), "zero3")
+        state = trainer.init_state()
+        n_sharded = 0
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state.opt_state):
+            if leaf.ndim >= 1 and leaf.size > 1:
+                assert any(a == FSDP_AXIS for a in leaf.sharding.spec), path
+                n_sharded += 1
+            else:
+                assert leaf.sharding.is_fully_replicated, path
+        assert n_sharded > 0
+
+    def test_zero2_params_replicated_moments_sharded(self):
+        trainer = make_trainer(MeshConfig(data=1, fsdp=8), "zero2")
+        state = trainer.init_state()
+        for _, leaf in jax.tree_util.tree_leaves_with_path(state.params):
+            assert leaf.sharding.is_fully_replicated
+        mom_sharded = [
+            leaf for _, leaf in jax.tree_util.tree_leaves_with_path(state.opt_state)
+            if leaf.ndim >= 1 and leaf.size > 1
+            and any(a == FSDP_AXIS for a in leaf.sharding.spec)
+        ]
+        assert len(mom_sharded) > 0
+
+    def test_replicated_everything(self):
+        trainer = make_trainer(MeshConfig(data=8, fsdp=1), "replicated")
+        state = trainer.init_state()
+        for _, leaf in jax.tree_util.tree_leaves_with_path(state.params):
+            assert leaf.sharding.is_fully_replicated
+
+    def test_fsdp_spec_indivisible_falls_back(self):
+        # 50257 (GPT-2 vocab) is not divisible by 8 → shard the hidden dim.
+        assert tuple(fsdp_spec((50257, 768), 8)) == (None, FSDP_AXIS)
+        # Nothing divisible → replicate.
+        assert tuple(fsdp_spec((7, 13), 8)) == ()
+
+    def test_zero3_memory_actually_saved(self):
+        # ZeRO-3's point: per-device param bytes ~ 1/8 of replicated.
+        t3 = make_trainer(MeshConfig(data=1, fsdp=8), "zero3")
+        s3 = t3.init_state()
+
+        def local_bytes(tree):
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                shard = leaf.addressable_shards[0]
+                total += shard.data.size * leaf.dtype.itemsize
+            return total
+
+        full = sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(s3.params))
+        assert local_bytes(s3.params) <= full / 8 + 1024

@@ -1,0 +1,83 @@
+"""LR schedule + optimizer decay-mask tests (SURVEY.md C13/C14).
+
+Checks the closed-form properties of warmup-cosine and verifies the two
+reference schedule bugs are fixed (SURVEY.md §2.1 b1/b4) and the decay mask
+matches the reference's grouped optimizer semantics (b5 fixed everywhere).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.optimizer import decay_mask, make_optimizer
+
+
+CFG = TrainingConfig(learning_rate=6e-4, warmup_steps=100, max_steps=1000)
+
+
+class TestSchedule:
+    def test_step_zero_is_zero(self):
+        # b1 fixed: step 0 trains at warmup LR ~ 0, not peak.
+        assert float(CFG.lr_at(0)) == 0.0
+
+    def test_linear_warmup(self):
+        np.testing.assert_allclose(float(CFG.lr_at(50)), 6e-4 * 0.5, rtol=1e-6)
+
+    def test_peak_at_warmup_end(self):
+        np.testing.assert_allclose(float(CFG.lr_at(100)), 6e-4, rtol=1e-6)
+
+    def test_min_lr_is_ten_percent(self):
+        np.testing.assert_allclose(float(CFG.lr_at(1000)), 6e-5, rtol=1e-5)
+
+    def test_clamped_past_max_steps(self):
+        # b4 fixed: beyond max_steps the LR holds at min_lr, never rises.
+        np.testing.assert_allclose(float(CFG.lr_at(5000)), 6e-5, rtol=1e-5)
+
+    def test_cosine_midpoint(self):
+        # Halfway through decay: coeff=0.5 → lr = min + 0.5*(peak-min).
+        mid = 100 + (1000 - 100) // 2
+        expected = 6e-5 + 0.5 * (6e-4 - 6e-5)
+        np.testing.assert_allclose(float(CFG.lr_at(mid)), expected, rtol=1e-4)
+
+    def test_monotone_decay_after_warmup(self):
+        lrs = [float(CFG.lr_at(s)) for s in range(100, 1001, 50)]
+        assert all(a >= b for a, b in zip(lrs, lrs[1:]))
+
+
+class TestDecayMask:
+    def params(self):
+        return {
+            "embed_tokens": {"embedding": jnp.ones((8, 4))},
+            "layers": {
+                "input_layernorm": {"weight": jnp.ones((4,))},
+                "post_attention_layernorm": {"weight": jnp.ones((4,))},
+                "attention": {"q_proj": {"kernel": jnp.ones((4, 4))}},
+                "mlp": {"down_proj": {"kernel": jnp.ones((4, 4))}},
+            },
+            "norm": {"weight": jnp.ones((4,))},
+        }
+
+    def test_norms_excluded_rest_decayed(self):
+        mask = decay_mask(self.params())
+        assert mask["embed_tokens"]["embedding"] is True  # embedding decays (ref)
+        assert mask["layers"]["input_layernorm"]["weight"] is False
+        assert mask["layers"]["post_attention_layernorm"]["weight"] is False
+        assert mask["norm"]["weight"] is False
+        assert mask["layers"]["attention"]["q_proj"]["kernel"] is True
+        assert mask["layers"]["mlp"]["down_proj"]["kernel"] is True
+
+    def test_weight_decay_actually_masked(self):
+        # With zero grads, AdamW still decays masked params; norm weights must
+        # stay exactly 1.0 while kernels shrink.
+        params = self.params()
+        opt = make_optimizer(
+            TrainingConfig(learning_rate=1e-1, warmup_steps=0, max_steps=10,
+                           weight_decay=0.5, grad_clip=1e9)
+        )
+        opt_state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        updates, _ = opt.update(grads, opt_state, params)
+        new = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        np.testing.assert_array_equal(new["norm"]["weight"], 1.0)
+        assert float(new["layers"]["mlp"]["down_proj"]["kernel"][0, 0]) < 1.0

@@ -1,0 +1,83 @@
+"""Synthetic random-token data (SURVEY.md C24).
+
+The reference's ``create_dummy_dataloader`` (``ddp_trainer.py:460-487``)
+builds a fixed random-token corpus so the whole training stack runs with no
+external data — its de-facto integration test backbone (SURVEY.md §4). Same
+here: deterministic per-seed corpus, per-process disjoint slices, numpy on the
+host (device placement happens in ``Trainer.put_batch``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+class DummyDataLoader:
+    """Yields ``[local_batch_size, seq_len]`` int32 token batches.
+
+    ``batch_size`` is the *global* loader batch (micro_batch x grad_accum x
+    data shards, matching the reference loader-batch semantics,
+    ``ddp_trainer.py:538``); each process receives its disjoint
+    ``batch_size / process_count`` rows — the analogue of the reference's
+    ``DistributedSampler`` striding (C25).
+    """
+
+    def __init__(
+        self,
+        batch_size: int,
+        seq_len: int,
+        vocab_size: int = 50257,
+        num_batches: int = 100,
+        seed: int = 1234,
+        process_index: int = 0,
+        process_count: int = 1,
+    ):
+        if batch_size % process_count != 0:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {process_count} processes"
+            )
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.num_batches = num_batches
+        self.seed = seed
+        self.process_index = process_index
+        self.process_count = process_count
+        self.local_batch_size = batch_size // process_count
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        for i in range(self.num_batches):
+            # Batch i is a pure function of (seed, i): all processes agree on
+            # the global batch and carve out disjoint row ranges.
+            rng = np.random.default_rng((self.seed, i))
+            batch = rng.integers(
+                0, self.vocab_size, (self.batch_size, self.seq_len), dtype=np.int32
+            )
+            lo = self.process_index * self.local_batch_size
+            yield batch[lo : lo + self.local_batch_size]
+
+
+def create_dummy_dataloader(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int = 50257,
+    num_batches: int = 100,
+    seed: int = 1234,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> DummyDataLoader:
+    """Factory, signature-parity with the reference (``ddp_trainer.py:460-487``)."""
+    return DummyDataLoader(
+        batch_size=batch_size,
+        seq_len=seq_len,
+        vocab_size=vocab_size,
+        num_batches=num_batches,
+        seed=seed,
+        process_index=process_index,
+        process_count=process_count,
+    )

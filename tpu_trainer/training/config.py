@@ -1,0 +1,75 @@
+"""Training configuration and LR schedule.
+
+Single unified ``TrainingConfig`` replacing the reference's two divergent
+copies (``ddp_trainer.py:34-63`` lr=6e-4/accum=4 vs ``fsdp_trainer.py:78-93``
+lr=3e-4/accum=8 — SURVEY.md §5.6). Defaults follow the DDP copy; the FSDP CLI
+overrides what it needs.
+
+The schedule is the reference's linear-warmup → cosine-to-10%-of-peak
+(``ddp_trainer.py:237-271``), with the two reference bugs fixed by design
+(SURVEY.md §2.1):
+
+- b1: the LR is a pure function of the step, applied functionally *inside*
+  the optimizer at each step — no set-after-step off-by-one.
+- b4: ``decay_ratio`` is clamped to [0, 1] so training past ``max_steps``
+  holds at ``min_lr`` (the DDP copy rises again past pi; the FSDP copy clamps).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingConfig:
+    """Unified training configuration (reference TrainingConfig union)."""
+
+    # Data
+    batch_size: int = 8           # per-data-shard micro-batch size
+    max_seq_len: int = 1024
+
+    # Optimization (reference ddp_trainer.py:40-45)
+    learning_rate: float = 6e-4
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip: float = 1.0
+
+    # Schedule (reference ddp_trainer.py:47-52)
+    max_steps: int = 10000
+    warmup_steps: int = 1000
+    log_interval: int = 1
+    eval_interval: int = 500
+    save_interval: int = 1000
+
+    # Mixed precision: "fp32" | "bf16" | "fp16" (reference ddp_trainer.py:55)
+    mixed_precision: str = "bf16"
+
+    # Gradient accumulation (reference ddp_trainer.py:58)
+    gradient_accumulation_steps: int = 4
+
+    # Checkpointing (reference ddp_trainer.py:61-63) — resume is actually
+    # wired here (the reference's resume_from is dead config, SURVEY.md §0.1)
+    checkpoint_dir: str = "checkpoints"
+    resume_from: Optional[str] = None
+
+    # RNG
+    seed: int = 0
+
+    @property
+    def min_lr(self) -> float:
+        return 0.1 * self.learning_rate
+
+    def lr_at(self, step) -> jnp.ndarray:
+        """LR as a pure (jit-friendly) function of step."""
+        step = jnp.asarray(step, jnp.float32)
+        peak = self.learning_rate
+        warmup = peak * step / max(1, self.warmup_steps)
+        decay_steps = max(1, self.max_steps - self.warmup_steps)
+        ratio = jnp.clip((step - self.warmup_steps) / decay_steps, 0.0, 1.0)
+        coeff = 0.5 * (1.0 + jnp.cos(jnp.pi * ratio))
+        cosine = self.min_lr + coeff * (peak - self.min_lr)
+        return jnp.where(step < self.warmup_steps, warmup, cosine)

@@ -1,0 +1,67 @@
+"""Optimizer construction (SURVEY.md C13/C14/C16).
+
+optax chain: global-norm clip → AdamW with masked weight decay. Decay-mask
+semantics follow the reference's *grouped* DDP optimizer
+(``ddp_trainer.py:174-234``): parameters whose path mentions a norm layer (or
+a bias, if one existed) are excluded from weight decay; everything else —
+including the embedding — decays. The reference's FSDP trainer decays
+everything (``fsdp_trainer.py:334-343`` — SURVEY.md §2.1 b5); the grouped
+behavior is used everywhere here, as the survey prescribes.
+
+Under GSPMD the optimizer is sharding-agnostic: the same chain runs
+replicated (DDP), with sharded moments (ZeRO-2), or fully sharded (ZeRO-3) —
+the global-norm clip's tree reduction becomes a partial-reduce + psum
+automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import optax
+
+from tpu_trainer.training.config import TrainingConfig
+
+_NO_DECAY_MARKERS = ("norm", "bias")
+
+
+def decay_mask(params: Any) -> Any:
+    """True where weight decay applies.
+
+    Name-based, matching the reference's exclusion of params whose name
+    contains 'bias' or 'norm' (``ddp_trainer.py:216-227``): our RMSNorm
+    modules are named ``*norm*`` and their weight vectors are excluded; the
+    projections and the (tied) embedding decay.
+    """
+
+    def keep(path, _leaf) -> bool:
+        keys = [str(getattr(p, "key", getattr(p, "name", ""))) for p in path]
+        return not any(marker in k.lower() for k in keys for marker in _NO_DECAY_MARKERS)
+
+    return jax.tree_util.tree_map_with_path(keep, params)
+
+
+def make_optimizer(config: TrainingConfig) -> optax.GradientTransformation:
+    """clip_by_global_norm → AdamW(masked decay), at unit learning rate.
+
+    The chain is built with ``learning_rate=1.0``; the trainer scales the
+    final updates by ``config.lr_at(state.step)`` itself. This keeps the
+    schedule a pure function of the trainer's step counter — including across
+    fp16 overflow-skipped steps, where torch semantics are "scheduler
+    advances, Adam's bias-correction count does not" (GradScaler skips
+    ``optimizer.step`` while the LR scheduler still ticks). AdamW's decoupled
+    decay is inside the chain, so the external scaling applies
+    ``p -= lr * (adam_update + wd * p)`` exactly like torch AdamW.
+    """
+    return optax.chain(
+        optax.clip_by_global_norm(config.grad_clip),
+        optax.adamw(
+            learning_rate=1.0,
+            b1=config.beta1,
+            b2=config.beta2,
+            eps=1e-8,
+            weight_decay=config.weight_decay,
+            mask=decay_mask,
+        ),
+    )

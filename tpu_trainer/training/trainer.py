@@ -1,0 +1,304 @@
+"""The trainer: one jitted train_step for every parallelism strategy.
+
+TPU-native re-design of the reference's two trainer classes
+(``DistributedTrainer``, ``ddp_trainer.py:66-456``; ``FSDPTrainer``,
+``fsdp_trainer.py:53-505``). The load-bearing property of the reference —
+*the model is parallelism-blind; the runtime layer decides placement*
+(SURVEY.md §1) — becomes literal here: DDP and FSDP are the **same**
+``train_step``, differing only in the NamedShardings handed to ``jax.jit``.
+
+Key mappings (SURVEY.md C9/C10/C15/C16):
+
+- DDP's ``no_sync`` + final-micro-step all-reduce (``ddp_trainer.py:329-342``)
+  → ``lax.scan`` over micro-batches accumulating local grads, one reduction
+  at the end (the no_sync equivalent is free — XLA reduces once, after the
+  scan, because that's where the grads are first consumed).
+- FSDP's per-module all-gather / reduce-scatter (``fsdp_trainer.py:369-384``)
+  → GSPMD-inserted collectives from the param/grad shardings; overlap comes
+  from XLA's latency-hiding scheduler (↔ ``backward_prefetch``).
+- ``clip_grad_norm_`` (``ddp_trainer.py:347-350``, ``fsdp_trainer.py:386-388``)
+  → ``optax.clip_by_global_norm`` inside the chain (global norm over sharded
+  trees = partial norms + psum, emitted automatically).
+- fp16 ``GradScaler`` (``ddp_trainer.py:152``) → dynamic loss scaling done
+  functionally in-step (scale up on a run of finite steps, halve + skip the
+  update on overflow). bf16 needs none of this (TPU-native recipe:
+  fp32 params, bf16 compute).
+- LR is applied per-step as a pure function of ``state.step`` inside the
+  optimizer — fixing the reference's set-after-step off-by-one (§2.1 b1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.struct as struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT
+from tpu_trainer.parallel import mesh as mesh_lib
+from tpu_trainer.parallel import sharding as shard_lib
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.optimizer import make_optimizer
+
+_MP_TO_DTYPE = {"fp32": "float32", "bf16": "bfloat16", "fp16": "float16"}
+_SCALE_GROWTH_INTERVAL = 2000  # steps of finite grads before doubling
+_MAX_LOSS_SCALE = 2.0**16
+_INIT_LOSS_SCALE = 2.0**15
+
+
+class TrainState(struct.PyTreeNode):
+    """Everything that evolves across steps (the checkpointable unit —
+    reference checkpoint dict, ``ddp_trainer.py:408-415``)."""
+
+    step: jax.Array            # int32 scalar
+    params: Any
+    opt_state: Any
+    rng: jax.Array             # dropout PRNG key chain
+    loss_scale: jax.Array      # float32 scalar (fp16 dynamic scaling; 1.0 else)
+    good_steps: jax.Array      # int32: consecutive finite-grad steps (fp16)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """How to parallelize: mesh shape + ZeRO mode.
+
+    - DDP (reference ddp_trainer): ``MeshConfig(data=-1)`` + ``"replicated"``.
+    - FSDP (reference fsdp_trainer): ``MeshConfig(fsdp=-1)`` + one of
+      ``zero3`` (FULL_SHARD) / ``zero2`` (SHARD_GRAD_OP) /
+      ``replicated`` (NO_SHARD); reference spellings accepted.
+    - HYBRID_SHARD: both axes > 1.
+    """
+
+    mesh: mesh_lib.MeshConfig = mesh_lib.MeshConfig()
+    sharding_strategy: str = "replicated"
+
+
+class Trainer:
+    """Owns the mesh, the jitted step, and state initialization.
+
+    Public interface mirrors the reference trainer (SURVEY.md §1 L4):
+    ``init_state()``, ``train_step(state, batch) -> (state, metrics)``,
+    ``put_batch``, plus ``process_index/process_count`` for rank discovery.
+    """
+
+    def __init__(
+        self,
+        model_config: GPTConfig,
+        training_config: TrainingConfig = TrainingConfig(),
+        parallel_config: ParallelConfig = ParallelConfig(),
+        mesh: Optional[Mesh] = None,
+    ):
+        # Mixed-precision policy → model compute dtype (reference
+        # ddp_trainer.py:115-156 autocast selection).
+        dtype = _MP_TO_DTYPE[training_config.mixed_precision]
+        self.model_config = dataclasses.replace(model_config, dtype=dtype)
+        self.training_config = training_config
+        self.parallel_config = parallel_config
+        self.strategy = shard_lib.canonical_strategy(parallel_config.sharding_strategy)
+        self.use_loss_scaling = training_config.mixed_precision == "fp16"
+
+        self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(parallel_config.mesh)
+        self.model = GPT(self.model_config)
+        self.optimizer = make_optimizer(training_config)
+
+        # --- shardings, from shapes only (no allocation) -------------------
+        state_shapes = jax.eval_shape(self._make_state, jax.random.PRNGKey(0))
+        replicated = P()
+        self._state_specs = TrainState(
+            step=replicated,
+            params=shard_lib.params_specs(state_shapes.params, self.mesh, self.strategy),
+            opt_state=shard_lib.opt_state_specs(
+                state_shapes.opt_state, self.mesh, self.strategy
+            ),
+            rng=replicated,
+            loss_scale=replicated,
+            good_steps=replicated,
+        )
+        self.state_shardings = shard_lib.to_shardings(self._state_specs, self.mesh)
+        self._grad_shardings = shard_lib.to_shardings(
+            shard_lib.grads_specs(state_shapes.params, self.mesh, self.strategy),
+            self.mesh,
+        )
+        self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
+
+        self._init_jit = jax.jit(self._make_state, out_shardings=self.state_shardings)
+        self._step_jit = jax.jit(
+            self._train_step,
+            donate_argnums=(0,),
+            in_shardings=(self.state_shardings, self.batch_sharding),
+            out_shardings=(self.state_shardings, None),
+        )
+
+    # --- rank discovery (↔ reference rank/world_size, ddp_trainer.py:101-103)
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def dp_size(self) -> int:
+        return mesh_lib.dp_size(self.mesh)
+
+    @property
+    def global_batch_size(self) -> int:
+        """Sequences consumed per optimizer step, across all devices."""
+        c = self.training_config
+        return c.batch_size * c.gradient_accumulation_steps * self.dp_size
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.global_batch_size * self.training_config.max_seq_len
+
+    # --- state ------------------------------------------------------------
+
+    def _make_state(self, rng: jax.Array) -> TrainState:
+        param_rng, dropout_rng = jax.random.split(rng)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        params = self.model.init(param_rng, dummy)["params"]
+        opt_state = self.optimizer.init(params)
+        init_scale = _INIT_LOSS_SCALE if self.use_loss_scaling else 1.0
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=dropout_rng,
+            loss_scale=jnp.asarray(init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+        )
+
+    def init_state(self, seed: Optional[int] = None) -> TrainState:
+        """Initialize (sharded directly on the mesh — params never exist
+        unsharded, unlike the reference's build-on-CPU-then-wrap)."""
+        seed = self.training_config.seed if seed is None else seed
+        return self._init_jit(jax.random.PRNGKey(seed))
+
+    # --- data placement -----------------------------------------------------
+
+    def put_batch(self, local_batch: np.ndarray) -> jax.Array:
+        """Host numpy ``[accum * local_bs, seq]`` → global sharded device array
+        ``[accum, global_bs, seq]`` (↔ reference micro-batch slicing,
+        ``ddp_trainer.py:320-326``, done once here instead of per micro-step).
+        """
+        accum = self.training_config.gradient_accumulation_steps
+        n, seq = local_batch.shape
+        if n % accum != 0:
+            raise ValueError(f"batch rows {n} not divisible by accum {accum}")
+        local = local_batch.reshape(accum, n // accum, seq)
+        global_shape = (accum, (n // accum) * self.process_count, seq)
+        return jax.make_array_from_process_local_data(
+            self.batch_sharding, local, global_shape
+        )
+
+    # --- the step -----------------------------------------------------------
+
+    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, dict]:
+        """One optimizer step over ``accum`` micro-batches.
+
+        ``batch``: the sharded ``[accum, global_bs, seq]`` device array from
+        ``put_batch``, or a **process-local** host array of shape
+        ``[accum * local_bs, seq]`` (or ``[accum, local_bs, seq]``), which is
+        placed automatically.
+        """
+        if not isinstance(batch, jax.Array):
+            batch = np.asarray(batch)
+            if batch.ndim == 3:
+                batch = batch.reshape(-1, batch.shape[-1])
+            batch = self.put_batch(batch)
+        return self._step_jit(state, batch)
+
+    def _train_step(self, state: TrainState, batch: jax.Array):
+        cfg = self.training_config
+        accum = cfg.gradient_accumulation_steps
+        assert batch.ndim == 3 and batch.shape[0] == accum
+
+        def loss_fn(params, micro, rng, scale):
+            _, loss = self.model.apply(
+                {"params": params},
+                micro,
+                labels=micro,
+                train=True,
+                rngs={"dropout": rng},
+            )
+            return loss * scale, loss
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+
+        def micro_step(carry, micro):
+            grads_acc, loss_acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            (_, loss), grads = grad_fn(state.params, micro, sub, state.loss_scale)
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            return (grads_acc, loss_acc + loss, rng), None
+
+        (grads, loss_sum, new_rng), _ = jax.lax.scan(
+            micro_step, (zero_grads, jnp.zeros((), jnp.float32), state.rng), batch
+        )
+        # Mean over micro-steps and undo the loss scale; then pin the grads to
+        # their ZeRO sharding (the reduce-scatter point under zero2/zero3).
+        denom = accum * state.loss_scale
+        grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+        grads = shard_lib.constrain(grads, self._grad_shardings)
+        loss = loss_sum / accum
+
+        grad_norm = optax.global_norm(grads)
+
+        # Schedule applied here, as a pure function of state.step (fixes b1;
+        # also keeps logged LR == applied LR across fp16 overflow skips, where
+        # the optimizer chain's internal count freezes but the schedule ticks).
+        lr = cfg.lr_at(state.step)
+
+        def apply_update(_):
+            updates, new_opt = self.optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
+            return optax.apply_updates(state.params, updates), new_opt
+
+        if self.use_loss_scaling:
+            finite = jnp.isfinite(grad_norm)
+            new_params, new_opt = jax.lax.cond(
+                finite, apply_update, lambda _: (state.params, state.opt_state), None
+            )
+            grew = state.good_steps + 1 >= _SCALE_GROWTH_INTERVAL
+            new_scale = jnp.where(
+                finite,
+                jnp.where(grew, jnp.minimum(state.loss_scale * 2.0, _MAX_LOSS_SCALE),
+                          state.loss_scale),
+                jnp.maximum(state.loss_scale * 0.5, 1.0),
+            )
+            new_good = jnp.where(finite, jnp.where(grew, 0, state.good_steps + 1), 0)
+        else:
+            new_params, new_opt = apply_update(None)
+            new_scale, new_good = state.loss_scale, state.good_steps
+
+        metrics = {
+            "loss": loss,
+            "lr": lr,
+            "grad_norm": grad_norm,
+            "loss_scale": state.loss_scale,
+        }
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            rng=new_rng,
+        )
+        if self.use_loss_scaling:
+            new_state = new_state.replace(loss_scale=new_scale, good_steps=new_good)
+        return new_state, metrics
