@@ -1,0 +1,101 @@
+"""Inference CLI: checkpoint → jitted generate → text.
+
+Re-design of the reference's ``src/eval/infer.py`` (SURVEY.md C27): Orbax
+restore instead of pickle (no ``TrainingConfig`` unpickle shim, no
+``weights_only`` fallback — reference ``infer.py:19-21,53-56``), a jitted
+sampling loop, and the model config read from the checkpoint's own metadata
+(``--model_size`` only needed for consolidated files). All four sizes load,
+including ``xl`` — the reference CLI caps at ``large`` while its FSDP trainer
+can train ``xl`` (SURVEY.md §2.1 b13).
+
+Usage::
+
+    python -m tpu_trainer.eval.infer --checkpoint checkpoints/step_00001000 \
+        --prompt "Once upon a time" --max_new_tokens 100 --temperature 0.8 --top_k 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import generate
+from tpu_trainer.utils.checkpoint import latest_checkpoint, restore_params
+from tpu_trainer.utils.tokenizer import get_tokenizer
+
+
+def force_cpu():
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="Generate text from a checkpoint")
+    p.add_argument("--checkpoint", required=True,
+                   help="step dir, checkpoint root (picks latest), or .msgpack")
+    p.add_argument("--model_size", default=None,
+                   choices=["small", "medium", "large", "xl"],
+                   help="only needed for consolidated .msgpack files")
+    p.add_argument("--prompt", default="Once upon a time")
+    p.add_argument("--max_new_tokens", type=int, default=100)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top_k", type=int, default=50)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--tokenizer", default="gpt2")
+    p.add_argument("--device", default=None, choices=[None, "cpu", "tpu"],
+                   help="cpu forces the host platform")
+    args = p.parse_args(argv)
+
+    if args.device == "cpu":
+        force_cpu()
+
+    path = args.checkpoint
+    resolved = latest_checkpoint(path)
+    if resolved is not None:
+        path = resolved
+    import os
+    if not os.path.exists(path):
+        p.error(f"checkpoint not found: {path}")
+    if os.path.isdir(path) and not os.path.exists(os.path.join(path, "meta.json")):
+        p.error(f"no checkpoint (meta.json) at {path}; pass a step dir, a "
+                f"checkpoint root containing step_* dirs, or a .msgpack file")
+    params, config = restore_params(path)
+    if config is None:
+        if args.model_size is None:
+            p.error("--model_size is required for consolidated checkpoints")
+        config = GPTConfig.preset(args.model_size)
+    # Sampling is deterministic-eval: no dropout.
+    import dataclasses
+    config = dataclasses.replace(config, dropout=0.0, attention_dropout=0.0)
+
+    tokenizer = get_tokenizer(args.tokenizer)
+    ids = tokenizer.encode(args.prompt)
+    if not ids:
+        ids = [min(tokenizer.eos_token_id, config.vocab_size - 1)]
+    if max(ids) >= config.vocab_size:
+        p.error(
+            f"prompt tokenizes to id {max(ids)} but the checkpoint's model has "
+            f"vocab_size {config.vocab_size} — tokenizer/model mismatch "
+            f"(tokenizer: {tokenizer.name})"
+        )
+    input_ids = jnp.asarray(ids, jnp.int32)[None, :]
+
+    out = generate(
+        params,
+        jax.random.PRNGKey(args.seed),
+        input_ids,
+        config=config,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+    )
+    text = tokenizer.decode(list(out[0]))
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,8 +51,10 @@ class TrainingConfig:
     # Gradient accumulation (reference ddp_trainer.py:58)
     gradient_accumulation_steps: int = 4
 
-    # Checkpointing (reference ddp_trainer.py:61-63) — resume is actually
-    # wired here (the reference's resume_from is dead config, SURVEY.md §0.1)
+    # Checkpointing (reference ddp_trainer.py:61-63). resume_from is consumed
+    # by the training CLI entrypoints (tpu_trainer.training.train), which also
+    # auto-resume from the latest checkpoint in checkpoint_dir — the
+    # reference's resume_from was dead config (SURVEY.md §0.1).
     checkpoint_dir: str = "checkpoints"
     resume_from: Optional[str] = None
 

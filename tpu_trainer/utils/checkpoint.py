@@ -1,0 +1,164 @@
+"""Checkpointing: Orbax sharded save/restore + consolidated export.
+
+TPU-native replacement for the reference's two checkpoint mechanisms
+(SURVEY.md C17/C18):
+
+- DDP: rank0 pickles {model, optimizer, global_step, tokens_seen, configs}
+  (``ddp_trainer.py:370-456``).
+- FSDP: FULL_STATE_DICT gather to rank0 with CPU offload, barrier, and a
+  broadcast-based load (``fsdp_trainer.py:405-494``) — with the known rank0
+  memory-spike limitation its own docstring admits.
+
+Here every host writes its own shards (no gather, no spike) and restore
+reshards natively onto whatever mesh/strategy the restoring trainer uses —
+save under ZeRO-3, resume under DDP, or vice versa. A consolidated
+single-file export (flax msgpack of gathered params) covers the "one file
+for inference elsewhere" use the reference's pickle served.
+
+Layout::
+
+    <dir>/step_00000100/state/   # orbax pytree of TrainState
+    <dir>/step_00000100/meta.json  # step, tokens_seen, model/training configs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel.mesh import barrier
+from tpu_trainer.training.config import TrainingConfig
+
+_STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
+
+
+def step_dir(checkpoint_dir: str, step: int) -> str:
+    return os.path.join(os.path.abspath(checkpoint_dir), f"step_{step:08d}")
+
+
+def latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
+    """Newest step_XXXXXXXX subdirectory, or None."""
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    best = None
+    for name in os.listdir(checkpoint_dir):
+        m = _STEP_DIR_RE.match(name)
+        if m and os.path.exists(os.path.join(checkpoint_dir, name, "meta.json")):
+            if best is None or int(m.group(1)) > int(best[0]):
+                best = (m.group(1), name)
+    return os.path.join(checkpoint_dir, best[1]) if best else None
+
+
+def save_checkpoint(
+    checkpoint_dir: str,
+    state,
+    *,
+    model_config: GPTConfig,
+    training_config: TrainingConfig,
+    tokens_seen: int = 0,
+) -> str:
+    """Write a sharded checkpoint; returns its path.
+
+    Every process participates (each writes its addressable shards); the
+    meta.json is written by host 0 last, so a checkpoint without meta.json is
+    incomplete and ignored by ``latest_checkpoint`` — the barrier-free
+    analogue of the reference's save-then-barrier (``fsdp_trainer.py:465``).
+    """
+    path = step_dir(checkpoint_dir, int(state.step))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "state"), state, force=True)
+    ckptr.wait_until_finished()
+    barrier("checkpoint_save")
+    if jax.process_index() == 0:
+        meta = {
+            "step": int(state.step),
+            "tokens_seen": int(tokens_seen),
+            "model_config": dataclasses.asdict(model_config),
+            "training_config": dataclasses.asdict(training_config),
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+    barrier("checkpoint_meta")
+    return path
+
+
+def load_meta(path: str) -> dict:
+    with open(os.path.join(path, "meta.json")) as f:
+        return json.load(f)
+
+
+def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
+    """Restore a TrainState onto the trainer's mesh/sharding (resharding as
+    needed) plus the saved metadata. ``trainer`` is a
+    ``tpu_trainer.training.trainer.Trainer``."""
+    meta = load_meta(path)
+    shapes = jax.eval_shape(trainer._make_state, jax.random.PRNGKey(0))
+    abstract = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes,
+        trainer.state_shardings,
+    )
+    state = ocp.StandardCheckpointer().restore(os.path.join(path, "state"), abstract)
+    return state, meta
+
+
+def restore_params(path: str):
+    """Restore only the model params — the inference path (↔ reference
+    ``infer.py:53-57``, minus the pickle shims). Accepts a step dir (builds a
+    trainer from the checkpoint's own meta.json and restores onto the default
+    devices) or a consolidated ``.msgpack`` file. Returns ``(params, config)``.
+    """
+    if os.path.isfile(path):  # consolidated export
+        import flax.serialization as ser
+
+        with open(path, "rb") as f:
+            return ser.msgpack_restore(f.read()), None
+    meta = load_meta(path)
+    from tpu_trainer.models.gpt import GPT  # local: avoid cycle
+
+    config = GPTConfig(**meta["model_config"])
+    shapes = jax.eval_shape(
+        lambda rng: GPT(config).init(rng, np.zeros((1, 8), np.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+    sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    abstract = jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding), shapes
+    )
+    # Partial restore: only the params subtree is read — an xl inference load
+    # must not pull the (2x param-sized) Adam moments off disk.
+    restored = ocp.PyTreeCheckpointer().restore(
+        os.path.join(path, "state"),
+        args=ocp.args.PyTreeRestore(item={"params": abstract}, partial_restore=True),
+    )
+    return restored["params"], config
+
+
+def export_consolidated(path: str, params, out_path: Optional[str] = None) -> str:
+    """Gather params to host 0 and write one msgpack file (↔ the reference's
+    single-file ``torch.save`` artifact, C17/C18 'export path')."""
+    import flax.serialization as ser
+
+    out_path = out_path or os.path.join(path, "params.msgpack")
+    if jax.process_count() > 1:
+        # Shards live on non-addressable devices: gather across processes
+        # first (np.asarray alone would raise on a multi-host sharded array).
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(params, tiled=True)
+    else:
+        gathered = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+    if jax.process_index() == 0:
+        with open(out_path, "wb") as f:
+            f.write(ser.msgpack_serialize(gathered))
+    barrier("export_consolidated")
+    return out_path

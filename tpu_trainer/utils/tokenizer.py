@@ -1,0 +1,71 @@
+"""Tokenizer access with an offline fallback.
+
+The reference uses HF ``GPT2TokenizerFast`` everywhere
+(``tinystories.py:122-134``, ``infer.py:60-61``). That requires a network
+fetch of the vocab on first use; this module tries it and falls back to a
+deterministic byte-level tokenizer (ids 0-255 = raw bytes, GPT-2-compatible
+vocab size) so every pipeline — data loading, training, inference — runs
+hermetically with no downloads.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class ByteTokenizer:
+    """UTF-8 byte fallback tokenizer (id = byte value; eos = 50256)."""
+
+    vocab_size = 50257
+    eos_token_id = 50256
+
+    name = "byte-fallback"
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids) -> str:
+        return bytes(int(i) for i in ids if 0 <= int(i) < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+
+class _HFWrapper:
+    def __init__(self, tok):
+        self._tok = tok
+        self.vocab_size = tok.vocab_size
+        self.eos_token_id = tok.eos_token_id
+        self.name = getattr(tok, "name_or_path", "hf")
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(list(int(i) for i in ids))
+
+
+def get_tokenizer(name: str = "gpt2"):
+    """GPT2TokenizerFast when locally cached; ByteTokenizer otherwise.
+
+    Only locally-cached HF tokenizers are used by default — a cache miss in an
+    air-gapped environment would otherwise stall for minutes in network
+    retries. Set ``TPU_TRAINER_ALLOW_DOWNLOAD=1`` to permit fetching.
+    """
+    import os
+    import warnings
+
+    try:
+        from transformers import GPT2TokenizerFast
+
+        local_only = os.environ.get("TPU_TRAINER_ALLOW_DOWNLOAD") != "1"
+        return _HFWrapper(
+            GPT2TokenizerFast.from_pretrained(name, local_files_only=local_only)
+        )
+    except Exception as e:
+        warnings.warn(
+            f"falling back to byte-level tokenizer: could not load HF tokenizer "
+            f"{name!r} ({type(e).__name__}: {e}). Token ids will NOT match a "
+            f"GPT-2-tokenized checkpoint.",
+            stacklevel=2,
+        )
+        return ByteTokenizer()
