@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# DDP training launcher (↔ reference scripts/train_ddp.sh, which autodetects
+# GPUs and execs torchrun). On TPU there is one process per host and the
+# devices are discovered by JAX; multi-host rendezvous is autodetected from
+# the TPU pod metadata (or COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID).
+#
+# Usage:
+#   ./scripts/train_ddp.sh [extra flags...]
+# Examples:
+#   ./scripts/train_ddp.sh --model_size small --max_steps 50        # smoke run
+#   ./scripts/train_ddp.sh --config configs/small_model.yaml
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# XLA/libtpu tuning (the NCCL-env analogue, reference train_ddp.sh:21).
+export LIBTPU_INIT_ARGS="${LIBTPU_INIT_ARGS:-}"
+
+N_DEVICES=$(python -c "import jax; print(jax.device_count())" 2>/dev/null || echo "?")
+echo "Starting DDP training on ${N_DEVICES} device(s)"
+
+exec python -m tpu_trainer.training.train_ddp "$@"

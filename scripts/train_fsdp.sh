@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# FSDP training launcher (↔ reference scripts/train_fsdp.sh). Params,
+# gradients, and optimizer state shard over the fsdp mesh axis; sharding
+# modes accept the reference spellings (FULL_SHARD / SHARD_GRAD_OP /
+# NO_SHARD / HYBRID_SHARD).
+#
+# Usage:
+#   ./scripts/train_fsdp.sh [extra flags...]
+# Examples:
+#   ./scripts/train_fsdp.sh --model_size medium --sharding FULL_SHARD
+#   ./scripts/train_fsdp.sh --config configs/medium_model.yaml
+#   ./scripts/train_fsdp.sh --sharding HYBRID_SHARD --mesh_data 2 --mesh_fsdp 4
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export LIBTPU_INIT_ARGS="${LIBTPU_INIT_ARGS:-}"
+
+N_DEVICES=$(python -c "import jax; print(jax.device_count())" 2>/dev/null || echo "?")
+echo "Starting FSDP training on ${N_DEVICES} device(s)"
+
+exec python -m tpu_trainer.training.train_fsdp "$@"

@@ -1,0 +1,139 @@
+"""CLI + YAML config tests (SURVEY.md C28/C29, §5.6).
+
+The reference documents ``--config configs/*.yaml`` but never loads YAML
+(SURVEY.md §0.1); these tests pin down that our CLI actually does, with the
+documented precedence (CLI flags > YAML > dataclass defaults), and that the
+training driver runs end to end — including the auto-resume path that the
+reference left dead.
+"""
+
+import dataclasses
+import os
+
+import pytest
+
+from tpu_trainer.training.cli import build_parser, resolve_configs, run_training
+
+TINY_YAML = """
+model:
+  name: "gpt2-small"
+  vocab_size: 128
+  hidden_size: 32
+  num_layers: 1
+  num_heads: 2
+  intermediate_size: 64
+  max_seq_len: 32
+  dropout: 0.0
+  attention_dropout: 0.0
+  use_flash_attention: false
+training:
+  batch_size: 2
+  gradient_accumulation_steps: 2
+  learning_rate: 1e-3
+  max_steps: 3
+  warmup_steps: 1
+  log_interval: 10
+  eval_interval: 100
+  save_interval: 100
+distributed:
+  mixed_precision: "fp32"
+data:
+  dataset: "dummy"
+"""
+
+
+@pytest.fixture
+def tiny_yaml(tmp_path):
+    p = tmp_path / "tiny.yaml"
+    p.write_text(TINY_YAML)
+    return str(p)
+
+
+class TestConfigResolution:
+    def test_yaml_is_actually_loaded(self, tiny_yaml):
+        args = build_parser("ddp").parse_args(["--config", tiny_yaml])
+        model, train, parallel, data = resolve_configs(args, "ddp")
+        assert model.hidden_size == 32
+        assert model.num_layers == 1
+        assert train.learning_rate == pytest.approx(1e-3)  # str-float coerced
+        assert train.gradient_accumulation_steps == 2
+        assert data["dataset"] == "dummy"
+
+    def test_cli_overrides_yaml(self, tiny_yaml):
+        args = build_parser("ddp").parse_args(
+            ["--config", tiny_yaml, "--batch_size", "4", "--max_steps", "7",
+             "--learning_rate", "5e-4"]
+        )
+        _, train, _, _ = resolve_configs(args, "ddp")
+        assert train.batch_size == 4
+        assert train.max_steps == 7
+        assert train.learning_rate == pytest.approx(5e-4)
+
+    def test_defaults_without_yaml(self):
+        args = build_parser("ddp").parse_args([])
+        model, train, parallel, _ = resolve_configs(args, "ddp")
+        assert model.hidden_size == 768          # small preset
+        assert train.learning_rate == pytest.approx(6e-4)
+        assert parallel.sharding_strategy == "replicated"
+        assert parallel.mesh.data == -1 and parallel.mesh.fsdp == 1
+
+    def test_fsdp_mode_reference_spellings(self, tiny_yaml):
+        for spelling, mesh_fsdp in [("FULL_SHARD", -1), ("SHARD_GRAD_OP", -1)]:
+            args = build_parser("fsdp").parse_args(
+                ["--config", tiny_yaml, "--sharding", spelling]
+            )
+            _, _, parallel, _ = resolve_configs(args, "fsdp")
+            assert parallel.sharding_strategy == spelling
+            assert parallel.mesh.fsdp == mesh_fsdp
+
+    def test_fsdp_activation_checkpointing_default_on(self, tiny_yaml):
+        # reference fsdp_trainer.py:312-328: ON unless --no_activation_checkpointing
+        args = build_parser("fsdp").parse_args(["--config", tiny_yaml])
+        model, _, _, _ = resolve_configs(args, "fsdp")
+        assert model.gradient_checkpointing
+        args = build_parser("fsdp").parse_args(
+            ["--config", tiny_yaml, "--no_activation_checkpointing"]
+        )
+        model, _, _, _ = resolve_configs(args, "fsdp")
+        assert not model.gradient_checkpointing
+
+    def test_hybrid_shard_requires_mesh_split(self, tiny_yaml):
+        args = build_parser("fsdp").parse_args(
+            ["--config", tiny_yaml, "--sharding", "HYBRID_SHARD"]
+        )
+        with pytest.raises(SystemExit):
+            resolve_configs(args, "fsdp")
+
+
+class TestEndToEnd:
+    def test_ddp_train_and_auto_resume(self, tiny_yaml, tmp_path, capsys):
+        ckpt = str(tmp_path / "ck")
+        rc = run_training(
+            ["--config", tiny_yaml, "--checkpoint_dir", ckpt,
+             "--num_batches", "8", "--eval_batches", "1"],
+            mode="ddp",
+        )
+        assert rc == 0
+        assert os.path.isdir(os.path.join(ckpt, "step_00000003"))
+        capsys.readouterr()
+        # Second invocation auto-resumes from step 3 and trains 2 more.
+        rc = run_training(
+            ["--config", tiny_yaml, "--checkpoint_dir", ckpt,
+             "--num_batches", "8", "--max_steps", "5", "--eval_batches", "1"],
+            mode="ddp",
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "resumed" in out and "step 3" in out
+        assert os.path.isdir(os.path.join(ckpt, "step_00000005"))
+
+    def test_fsdp_zero3_end_to_end(self, tiny_yaml, tmp_path):
+        ckpt = str(tmp_path / "ck_fsdp")
+        rc = run_training(
+            ["--config", tiny_yaml, "--sharding", "FULL_SHARD",
+             "--checkpoint_dir", ckpt, "--num_batches", "8",
+             "--eval_batches", "1"],
+            mode="fsdp",
+        )
+        assert rc == 0
+        assert os.path.isdir(os.path.join(ckpt, "step_00000003"))

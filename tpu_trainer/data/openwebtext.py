@@ -1,0 +1,44 @@
+"""OpenWebText dataset (SURVEY.md C21).
+
+Thin wrapper over the shared text engine with the reference factory's
+signature (``/root/reference/src/data/openwebtext.py:133-145``). The
+OpenWebText-specific behaviors — gzip transparency
+(``openwebtext.py:32-37,71-74``) and the ``.gz``↔plain path fallback
+(``openwebtext.py:147-155``) — live in the shared engine
+(``text.open_text`` / ``text.resolve_path``) and apply automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_trainer.data.text import TextDataLoader, create_text_dataloader
+
+
+def create_openwebtext_dataloader(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    *,
+    tokenizer_name: str = "gpt2",
+    max_tokens: Optional[int] = None,
+    streaming: bool = False,
+    cache_max_tokens: Optional[int] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    seed: int = 0,
+) -> TextDataLoader:
+    """Reference-parity factory (``openwebtext.py:133-181``): ``batch_size``
+    is rows per host; yields ``[batch_size, seq_len]`` int32 batches."""
+    return create_text_dataloader(
+        path,
+        batch_size,
+        seq_len,
+        tokenizer_name=tokenizer_name,
+        max_tokens=max_tokens,
+        streaming=streaming,
+        cache_max_tokens=cache_max_tokens,
+        process_index=process_index,
+        process_count=process_count,
+        seed=seed,
+    )

@@ -1,0 +1,294 @@
+"""Shared text-data engine: map-style + streaming tokenized datasets.
+
+The reference implements this twice with near-identical code
+(``/root/reference/src/data/tinystories.py`` and ``.../openwebtext.py`` —
+SURVEY.md C20-C23); here the engine is one module and the dataset-specific
+factories are thin wrappers (the de-duplication its README promised as
+``src/data/dataloader.py`` but never shipped, SURVEY.md §0.1).
+
+Components, with reference parity:
+
+- **LRU token cache** (``tinystories.py:62-82``, ``openwebtext.py:67-93``):
+  ``OrderedDict`` keyed by line index with a total-token budget
+  (``cache_max_tokens``), evicting from the front.
+- **Map-style dataset** (``tinystories.py:22-50``): tokenize the whole file
+  up front (optionally capped by ``max_tokens``), concatenate, split into
+  fixed ``seq_len`` chunks.
+- **Streaming dataset** (``tinystories.py:53-119``, ``openwebtext.py:95-130``):
+  line-modulo host sharding (``line_idx % num_shards == shard_id``,
+  ``tinystories.py:98``), rolling token buffer emitting ``seq_len`` chunks
+  (``:113-116``), ``max_tokens`` budget (``:103-108``). The shard is the JAX
+  process (``process_index/process_count``) — the host is the worker on TPU,
+  so the reference's ``rank*num_workers + worker_id`` collapses to the
+  process index.
+- **gzip transparency** (``openwebtext.py:32-37,71-74``) and ``.gz``↔plain
+  path fallback (``openwebtext.py:147-155``) — available to every dataset.
+- **Distributed sampling** (map-style; ``tinystories.py:150``,
+  ``ddp_trainer.py:478``): per-host disjoint index striding with
+  ``drop_last`` semantics, reshuffled per epoch with an epoch-seeded
+  permutation — the ``set_epoch`` the reference forgets to call
+  (SURVEY.md §2.1 b11).
+
+Everything is host-side numpy; device placement happens in
+``Trainer.put_batch`` with the batch's NamedSharding.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+from collections import OrderedDict
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from tpu_trainer.utils.tokenizer import get_tokenizer
+
+
+class LRUTokenCache:
+    """Token-budget LRU cache keyed by line index (reference
+    ``tinystories.py:62-82``)."""
+
+    def __init__(self, max_tokens: Optional[int]):
+        self.max_tokens = max_tokens
+        self._cache: OrderedDict[int, List[int]] = OrderedDict()
+        self._tokens = 0
+
+    def get(self, key: int) -> Optional[List[int]]:
+        if key not in self._cache:
+            return None
+        self._cache.move_to_end(key)
+        return self._cache[key]
+
+    def put(self, key: int, tokens: List[int]) -> None:
+        if self.max_tokens is None or self.max_tokens <= 0:
+            return
+        if key in self._cache:
+            return
+        self._cache[key] = tokens
+        self._tokens += len(tokens)
+        while self._tokens > self.max_tokens and self._cache:
+            _, evicted = self._cache.popitem(last=False)  # evict oldest
+            self._tokens -= len(evicted)
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+
+def resolve_path(path: str) -> str:
+    """``.gz``↔plain fallback (reference ``openwebtext.py:147-155``): if the
+    given path is missing but its gz (or ungz) sibling exists, use that."""
+    if os.path.exists(path):
+        return path
+    if path.endswith(".gz") and os.path.exists(path[:-3]):
+        return path[:-3]
+    if not path.endswith(".gz") and os.path.exists(path + ".gz"):
+        return path + ".gz"
+    raise FileNotFoundError(path)
+
+
+def open_text(path: str):
+    """Transparent text open for plain or gzip files
+    (reference ``openwebtext.py:32-37``)."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8", errors="replace")
+    return open(path, "r", encoding="utf-8", errors="replace")
+
+
+class TextDataset:
+    """Map-style: tokenize the whole file, chunk to ``seq_len``
+    (reference ``tinystories.py:22-50``).
+
+    ``__getitem__(i)`` returns an int32 ``[seq_len]`` chunk.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        tokenizer_name: str = "gpt2",
+        max_tokens: Optional[int] = None,
+    ):
+        self.path = resolve_path(path)
+        self.seq_len = seq_len
+        tokenizer = get_tokenizer(tokenizer_name)
+        ids: List[int] = []
+        with open_text(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                ids.extend(tokenizer.encode(line))
+                ids.append(tokenizer.eos_token_id)
+                if max_tokens is not None and len(ids) >= max_tokens:
+                    ids = ids[:max_tokens]
+                    break
+        n_chunks = len(ids) // seq_len
+        if n_chunks == 0:
+            raise ValueError(
+                f"{path}: only {len(ids)} tokens, need >= seq_len ({seq_len})"
+            )
+        self.chunks = np.asarray(
+            ids[: n_chunks * seq_len], dtype=np.int32
+        ).reshape(n_chunks, seq_len)
+
+    def __len__(self) -> int:
+        return self.chunks.shape[0]
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        return self.chunks[i]
+
+
+class StreamingTextDataset:
+    """Iterable: line-modulo sharded streaming with a rolling token buffer
+    (reference ``tinystories.py:53-119``, ``openwebtext.py:95-130``).
+
+    Yields int32 ``[seq_len]`` chunks. Re-iterating starts a new pass over
+    the file (the LRU cache persists across passes, which is when it pays —
+    reference behavior, SURVEY.md §2.1 b10).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        tokenizer_name: str = "gpt2",
+        max_tokens: Optional[int] = None,
+        cache_max_tokens: Optional[int] = None,
+        shard_id: int = 0,
+        num_shards: int = 1,
+    ):
+        self.path = resolve_path(path)
+        self.seq_len = seq_len
+        self.tokenizer = get_tokenizer(tokenizer_name)
+        self.max_tokens = max_tokens
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.cache = LRUTokenCache(cache_max_tokens)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        buffer: List[int] = []
+        tokens_seen = 0
+        with open_text(self.path) as f:
+            for line_idx, line in enumerate(f):
+                if line_idx % self.num_shards != self.shard_id:
+                    continue
+                line = line.strip()
+                if not line:
+                    continue
+                tokens = self.cache.get(line_idx)
+                if tokens is None:
+                    tokens = self.tokenizer.encode(line) + [
+                        self.tokenizer.eos_token_id
+                    ]
+                    self.cache.put(line_idx, tokens)
+                # max_tokens budget (reference tinystories.py:103-108)
+                if self.max_tokens is not None:
+                    remaining = self.max_tokens - tokens_seen
+                    if remaining <= 0:
+                        return
+                    tokens = tokens[:remaining]
+                tokens_seen += len(tokens)
+                buffer.extend(tokens)
+                while len(buffer) >= self.seq_len:
+                    yield np.asarray(buffer[: self.seq_len], dtype=np.int32)
+                    buffer = buffer[self.seq_len :]
+
+
+class TextDataLoader:
+    """Batches chunks into ``[rows_per_host, seq_len]`` int32 arrays.
+
+    ``batch_size`` is the per-host row count (= micro_batch x grad_accum x
+    local data shards — torch's per-rank DataLoader semantics,
+    ``ddp_trainer.py:538``). Map-style epochs reshuffle with an epoch-seeded
+    permutation and stride disjoint rows per host (C25 + b11 fix); streaming
+    shards lines per host (C22).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        process_index: int = 0,
+        process_count: int = 1,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.process_index = process_index
+        self.process_count = process_count
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.streaming = not hasattr(dataset, "__len__")
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        if self.streaming:
+            rows = []
+            for chunk in self.dataset:
+                rows.append(chunk)
+                if len(rows) == self.batch_size:
+                    yield np.stack(rows)
+                    rows = []
+            if rows and not self.drop_last:
+                yield np.stack(rows)
+        else:
+            n = len(self.dataset)
+            rng = np.random.default_rng((self.seed, self.epoch))
+            order = rng.permutation(n)
+            # Disjoint per-host strides; drop the ragged tail so every host
+            # sees the same number of full batches (drop_last=True,
+            # reference tinystories.py:158).
+            stride = self.process_count * self.batch_size
+            order = order[: (n // stride) * stride]
+            local = order[self.process_index :: self.process_count]
+            n_batches = len(local) // self.batch_size
+            for b in range(n_batches):
+                idx = local[b * self.batch_size : (b + 1) * self.batch_size]
+                yield np.stack([self.dataset[i] for i in idx])
+            self.epoch += 1
+
+    def __len__(self) -> int:
+        if self.streaming:
+            raise TypeError("streaming loader has no length")
+        stride = self.process_count * self.batch_size
+        return len(self.dataset) // stride
+
+
+def create_text_dataloader(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    *,
+    tokenizer_name: str = "gpt2",
+    max_tokens: Optional[int] = None,
+    streaming: bool = False,
+    cache_max_tokens: Optional[int] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    seed: int = 0,
+) -> TextDataLoader:
+    """Factory shared by the dataset-specific wrappers (reference factory
+    signatures: ``tinystories.py:122-134``, ``openwebtext.py:133-145``)."""
+    if streaming:
+        dataset = StreamingTextDataset(
+            path,
+            seq_len,
+            tokenizer_name=tokenizer_name,
+            max_tokens=max_tokens,
+            cache_max_tokens=cache_max_tokens,
+            shard_id=process_index,
+            num_shards=process_count,
+        )
+    else:
+        dataset = TextDataset(
+            path, seq_len, tokenizer_name=tokenizer_name, max_tokens=max_tokens
+        )
+    return TextDataLoader(
+        dataset,
+        batch_size,
+        process_index=process_index,
+        process_count=process_count,
+        seed=seed,
+    )

@@ -1,0 +1,42 @@
+"""TinyStories dataset (SURVEY.md C20).
+
+Thin wrapper over the shared text engine (``tpu_trainer.data.text``) with the
+reference factory's signature (``/root/reference/src/data/tinystories.py:122-134``).
+TinyStories is a plain-text file of one story per line; both map-style and
+streaming modes are supported, as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_trainer.data.text import TextDataLoader, create_text_dataloader
+
+
+def create_tinystories_dataloader(
+    path: str,
+    batch_size: int,
+    seq_len: int,
+    *,
+    tokenizer_name: str = "gpt2",
+    max_tokens: Optional[int] = None,
+    streaming: bool = False,
+    cache_max_tokens: Optional[int] = None,
+    process_index: int = 0,
+    process_count: int = 1,
+    seed: int = 0,
+) -> TextDataLoader:
+    """Reference-parity factory (``tinystories.py:122-161``): ``batch_size``
+    is rows per host; yields ``[batch_size, seq_len]`` int32 batches."""
+    return create_text_dataloader(
+        path,
+        batch_size,
+        seq_len,
+        tokenizer_name=tokenizer_name,
+        max_tokens=max_tokens,
+        streaming=streaming,
+        cache_max_tokens=cache_max_tokens,
+        process_index=process_index,
+        process_count=process_count,
+        seed=seed,
+    )

@@ -140,6 +140,11 @@ def batch_spec() -> P:
     return P(None, (DATA_AXIS, FSDP_AXIS), None)
 
 
+def batch_spec_2d() -> P:
+    """PartitionSpec for a plain ``[batch, seq]`` batch (eval/inference)."""
+    return P((DATA_AXIS, FSDP_AXIS), None)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_spec())
 
@@ -153,6 +158,16 @@ def barrier(name: str = "barrier") -> None:
     """Cross-host barrier (↔ ``dist.barrier()``, reference fsdp_trainer.py:465)."""
     if jax.process_count() > 1:
         multihost_utils.sync_global_devices(name)
+
+
+def global_any(flag: bool) -> bool:
+    """True on every host iff ``flag`` is True on any host — the coordination
+    primitive for preemption (one host's SIGTERM must make *all* hosts enter
+    the collective checkpoint save together, or the save deadlocks)."""
+    if jax.process_count() <= 1:
+        return flag
+    votes = multihost_utils.process_allgather(np.asarray([bool(flag)]))
+    return bool(np.any(votes))
 
 
 def broadcast_from_host0(pytree):

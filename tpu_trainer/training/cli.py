@@ -1,0 +1,474 @@
+"""Training CLI: the shared main behind ``train_ddp`` and ``train_fsdp``.
+
+TPU-native re-design of the reference's two ``main()`` entry points
+(``/root/reference/src/training/ddp_trainer.py:490-625``,
+``.../fsdp_trainer.py:530-616``), unified into one driver (the
+``trainer_utils`` layer the reference promised but never wrote —
+SURVEY.md §0.1). Differences by design:
+
+- **YAML configs are actually loaded.** The reference documents
+  ``--config configs/small_model.yaml`` but defines no such flag
+  (SURVEY.md §0.1); here ``--config`` parses the same YAML schema
+  (``/root/reference/configs/small_model.yaml``) into the dataclasses, with
+  CLI flags taking precedence over YAML over defaults.
+- **Resume is wired.** ``--resume_from`` restores a checkpoint; with no flag,
+  the latest checkpoint under ``--checkpoint_dir`` is auto-restored (the
+  reference's ``resume_from`` was dead config and ``load_checkpoint`` was
+  never called — SURVEY.md §5.3).
+- **Preemption handling.** SIGTERM (routine on TPU pools) checkpoints at the
+  next step boundary and exits cleanly.
+- **A real eval loop.** ``eval_interval`` triggers forward-only loss
+  evaluation (the reference declares the field but has no eval loop anywhere
+  — SURVEY.md §0.1).
+
+Flag parity: every reference flag is accepted (DDP set,
+``ddp_trainer.py:494-510``; FSDP set incl. ``--sharding``/``--cpu_offload``/
+``--no_activation_checkpointing``, ``fsdp_trainer.py:531-538``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import signal
+import warnings
+from typing import Optional
+
+import numpy as np
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel import mesh as mesh_lib
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.trainer import ParallelConfig, Trainer
+from tpu_trainer.utils import checkpoint as ckpt_lib
+from tpu_trainer.utils.logging import MetricLogger
+
+_SHARDING_CHOICES = [
+    "FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD",
+    "zero3", "zero2", "replicated", "ddp",
+]
+
+
+def build_parser(mode: str) -> argparse.ArgumentParser:
+    """Argument parser; defaults are ``None`` sentinels so that explicit CLI
+    flags can be layered over YAML over dataclass defaults."""
+    p = argparse.ArgumentParser(
+        description=f"TPU-native GPT training ({mode})",
+    )
+    p.add_argument("--config", type=str, default=None,
+                   help="YAML config (reference configs/*.yaml schema)")
+    # model (reference ddp_trainer.py:495-499)
+    p.add_argument("--model_size", type=str, default=None,
+                   choices=["small", "medium", "large", "xl"])
+    p.add_argument("--seq_len", type=int, default=None)
+    p.add_argument("--gradient_checkpointing", action="store_true", default=None)
+    p.add_argument("--no_flash_attention", action="store_true", default=None)
+    # training (reference ddp_trainer.py:496-502)
+    p.add_argument("--batch_size", type=int, default=None,
+                   help="per-data-shard micro-batch size")
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--warmup_steps", type=int, default=None)
+    p.add_argument("--grad_accum", "--gradient_accumulation_steps",
+                   dest="grad_accum", type=int, default=None)
+    p.add_argument("--mixed_precision", type=str, default=None,
+                   choices=["fp32", "bf16", "fp16"])
+    # data (reference ddp_trainer.py:503-510)
+    p.add_argument("--dataset", type=str, default=None,
+                   choices=["dummy", "tinystories", "openwebtext"])
+    p.add_argument("--data_path", type=str, default=None)
+    p.add_argument("--max_tokens", type=int, default=None)
+    p.add_argument("--streaming", action="store_true", default=None)
+    p.add_argument("--cache_max_tokens", type=int, default=None)
+    p.add_argument("--num_batches", type=int, default=None,
+                   help="dummy-dataset corpus size in batches")
+    p.add_argument("--tokenizer", type=str, default=None)
+    # schedule / logging / checkpointing
+    p.add_argument("--log_interval", type=int, default=None)
+    p.add_argument("--eval_interval", type=int, default=None)
+    p.add_argument("--eval_batches", type=int, default=None)
+    p.add_argument("--save_interval", type=int, default=None)
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--resume_from", type=str, default=None)
+    p.add_argument("--no_auto_resume", action="store_true", default=None)
+    p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    # mesh / multi-host
+    p.add_argument("--mesh_data", type=int, default=None)
+    p.add_argument("--mesh_fsdp", type=int, default=None)
+    p.add_argument("--mesh_tensor", type=int, default=None)
+    p.add_argument("--multihost", action="store_true", default=None,
+                   help="force jax.distributed.initialize() autodetect")
+    p.add_argument("--device", type=str, default=None,
+                   choices=["cpu", "tpu"],
+                   help="force a JAX platform (cpu works even when a TPU "
+                        "plugin is registered; the TPU->CPU fallback chain "
+                        "replaces the reference's cuda->mps->cpu)")
+    if mode == "fsdp":
+        # reference fsdp_trainer.py:531-538
+        p.add_argument("--sharding", type=str, default=None,
+                       choices=_SHARDING_CHOICES)
+        p.add_argument("--cpu_offload", action="store_true", default=None)
+        p.add_argument("--no_activation_checkpointing", action="store_true",
+                       default=None)
+    return p
+
+
+def load_yaml(path: Optional[str]) -> dict:
+    if not path:
+        return {}
+    import yaml
+
+    with open(path) as f:
+        loaded = yaml.safe_load(f) or {}
+    if not isinstance(loaded, dict):
+        raise ValueError(f"{path}: expected a mapping at top level")
+    return loaded
+
+
+def _pick(*values):
+    """First non-None value (CLI > YAML > default layering)."""
+    for v in values:
+        if v is not None:
+            return v
+    return None
+
+
+def _pickf(*values) -> Optional[float]:
+    """_pick + float coercion: YAML 1.1 parses bare '6e-4' as a string."""
+    v = _pick(*values)
+    return None if v is None else float(v)
+
+
+def _picki(*values) -> Optional[int]:
+    v = _pick(*values)
+    return None if v is None else int(v)
+
+
+def _preset_from_name(name: Optional[str]) -> Optional[str]:
+    """Map a YAML model name like 'gpt2-small' to a preset key."""
+    if not name:
+        return None
+    for key in ("small", "medium", "large", "xl"):
+        if key in name:
+            return key
+    return None
+
+
+def resolve_configs(args, mode: str):
+    """Layer CLI flags over YAML over dataclass defaults → config objects."""
+    y = load_yaml(args.config)
+    y_model = y.get("model", {}) or {}
+    y_train = y.get("training", {}) or {}
+    y_dist = y.get("distributed", {}) or {}
+    y_fsdp = y.get("fsdp", {}) or {}
+    y_data = y.get("data", {}) or {}
+    y_ckpt = y.get("checkpoint", {}) or {}
+
+    # --- model ---------------------------------------------------------
+    preset = _pick(args.model_size, _preset_from_name(y_model.get("name")), "small")
+    model_config = GPTConfig.preset(preset)
+    overrides = {}
+    for yaml_key, field in [
+        ("vocab_size", "vocab_size"), ("hidden_size", "hidden_size"),
+        ("num_layers", "num_layers"), ("num_heads", "num_heads"),
+        ("intermediate_size", "intermediate_size"), ("max_seq_len", "max_seq_len"),
+        ("dropout", "dropout"), ("attention_dropout", "attention_dropout"),
+        ("use_flash_attention", "use_flash_attention"),
+        ("gradient_checkpointing", "gradient_checkpointing"),
+    ]:
+        if yaml_key in y_model:
+            overrides[field] = y_model[yaml_key]
+    if args.seq_len is not None:
+        overrides["max_seq_len"] = args.seq_len
+    if args.gradient_checkpointing:
+        overrides["gradient_checkpointing"] = True
+    if mode == "fsdp":
+        # FSDP default: activation checkpointing ON unless disabled
+        # (reference fsdp_trainer.py:312-328, --no_activation_checkpointing).
+        no_ckpt = getattr(args, "no_activation_checkpointing", None)
+        if no_ckpt:
+            overrides["gradient_checkpointing"] = False
+        elif "gradient_checkpointing" not in overrides and not args.gradient_checkpointing:
+            overrides["gradient_checkpointing"] = True
+    if args.no_flash_attention:
+        overrides["use_flash_attention"] = False
+    elif "use_flash_attention" not in overrides:
+        overrides["use_flash_attention"] = True
+    model_config = dataclasses.replace(model_config, **overrides)
+
+    # --- training ------------------------------------------------------
+    defaults = TrainingConfig()
+    training_config = TrainingConfig(
+        batch_size=_picki(args.batch_size, y_train.get("batch_size"),
+                          defaults.batch_size),
+        max_seq_len=model_config.max_seq_len,
+        learning_rate=_pickf(args.learning_rate, y_train.get("learning_rate"),
+                             defaults.learning_rate),
+        weight_decay=_pickf(y_train.get("weight_decay"), defaults.weight_decay),
+        beta1=_pickf(y_train.get("beta1"), defaults.beta1),
+        beta2=_pickf(y_train.get("beta2"), defaults.beta2),
+        grad_clip=_pickf(y_train.get("grad_clip"), defaults.grad_clip),
+        max_steps=_picki(args.max_steps, y_train.get("max_steps"),
+                         defaults.max_steps),
+        warmup_steps=_picki(args.warmup_steps, y_train.get("warmup_steps"),
+                            defaults.warmup_steps),
+        log_interval=_picki(args.log_interval, y_train.get("log_interval"),
+                            defaults.log_interval),
+        eval_interval=_picki(args.eval_interval, y_train.get("eval_interval"),
+                             defaults.eval_interval),
+        save_interval=_picki(args.save_interval, y_train.get("save_interval"),
+                             defaults.save_interval),
+        mixed_precision=_pick(args.mixed_precision,
+                              y_dist.get("mixed_precision"),
+                              defaults.mixed_precision),
+        gradient_accumulation_steps=_picki(
+            args.grad_accum, y_train.get("gradient_accumulation_steps"),
+            defaults.gradient_accumulation_steps),
+        checkpoint_dir=_pick(args.checkpoint_dir, y_ckpt.get("dir"),
+                             defaults.checkpoint_dir),
+        resume_from=_pick(args.resume_from, y_ckpt.get("resume_from")),
+        seed=_picki(args.seed, y_train.get("seed"), defaults.seed),
+    )
+
+    # --- parallelism ---------------------------------------------------
+    if mode == "fsdp":
+        strategy = _pick(getattr(args, "sharding", None),
+                         y_fsdp.get("sharding_strategy"), "FULL_SHARD")
+        if getattr(args, "cpu_offload", None) or y_fsdp.get("cpu_offload"):
+            warnings.warn(
+                "cpu_offload: host-memory offload of optimizer state is not "
+                "implemented yet; running fully on-device", stacklevel=2,
+            )
+        default_mesh = mesh_lib.MeshConfig(data=1, fsdp=-1)
+    else:
+        strategy = "replicated"
+        default_mesh = mesh_lib.MeshConfig(data=-1, fsdp=1)
+    if strategy == "HYBRID_SHARD" and args.mesh_data is None and args.mesh_fsdp is None:
+        raise SystemExit(
+            "HYBRID_SHARD needs an explicit mesh split: pass --mesh_data and "
+            "--mesh_fsdp (data replicas x fsdp shards). (In the reference this "
+            "mode is documented but unselectable — SURVEY.md §2.)"
+        )
+    mesh_config = mesh_lib.MeshConfig(
+        data=_pick(args.mesh_data, default_mesh.data),
+        fsdp=_pick(args.mesh_fsdp, default_mesh.fsdp),
+        tensor=_pick(args.mesh_tensor, default_mesh.tensor),
+    )
+    parallel_config = ParallelConfig(mesh=mesh_config, sharding_strategy=strategy)
+
+    data_opts = {
+        "dataset": _pick(args.dataset, y_data.get("dataset"), "dummy"),
+        "data_path": _pick(args.data_path, y_data.get("path")),
+        "max_tokens": _pick(args.max_tokens, y_data.get("max_tokens")),
+        "streaming": bool(_pick(args.streaming, y_data.get("streaming"), False)),
+        "cache_max_tokens": _pick(args.cache_max_tokens,
+                                  y_data.get("cache_max_tokens")),
+        "num_batches": _pick(args.num_batches, 100),
+        "tokenizer": _pick(args.tokenizer, y_data.get("tokenizer"), "gpt2"),
+        "metrics_jsonl": args.metrics_jsonl,
+        "eval_batches": _pick(args.eval_batches, 8),
+        "auto_resume": not args.no_auto_resume,
+    }
+    return model_config, training_config, parallel_config, data_opts
+
+
+def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
+    """Train + (optional) eval loaders yielding per-host ``[rows, seq]``.
+
+    rows = grad_accum x micro_batch x (local data shards) — the reference's
+    loader-batch semantics (``ddp_trainer.py:538``) applied per host.
+    """
+    c = trainer.training_config
+    rows = (c.gradient_accumulation_steps * c.batch_size * trainer.dp_size
+            ) // trainer.process_count
+    name = data_opts["dataset"]
+    if name == "dummy":
+        from tpu_trainer.data.dummy import create_dummy_dataloader
+
+        train = create_dummy_dataloader(
+            batch_size=rows * trainer.process_count,
+            seq_len=c.max_seq_len,
+            vocab_size=model_config.vocab_size,
+            num_batches=data_opts["num_batches"],
+            seed=c.seed + 1234,
+            process_index=trainer.process_index,
+            process_count=trainer.process_count,
+        )
+        eval_loader = create_dummy_dataloader(
+            batch_size=rows * trainer.process_count,
+            seq_len=c.max_seq_len,
+            vocab_size=model_config.vocab_size,
+            num_batches=data_opts["eval_batches"],
+            seed=c.seed + 4321,   # disjoint synthetic eval corpus
+            process_index=trainer.process_index,
+            process_count=trainer.process_count,
+        )
+        return train, eval_loader
+    if name == "tinystories":
+        from tpu_trainer.data.tinystories import create_tinystories_dataloader as factory
+    elif name == "openwebtext":
+        from tpu_trainer.data.openwebtext import create_openwebtext_dataloader as factory
+    else:
+        raise ValueError(f"unknown dataset {name!r}")
+    if not data_opts["data_path"]:
+        raise SystemExit(f"--data_path is required for dataset {name!r}")
+    train = factory(
+        data_opts["data_path"],
+        batch_size=rows,
+        seq_len=c.max_seq_len,
+        tokenizer_name=data_opts["tokenizer"],
+        max_tokens=data_opts["max_tokens"],
+        streaming=data_opts["streaming"],
+        cache_max_tokens=data_opts["cache_max_tokens"],
+        process_index=trainer.process_index,
+        process_count=trainer.process_count,
+        seed=trainer.training_config.seed,
+    )
+    # Text eval: smoke-eval on a deterministic re-pass of the data (held-out
+    # splits are the user's responsibility, as in the reference which has no
+    # eval at all). A separate loader over the same chunk matrix keeps the
+    # training loader's epoch/shuffle state untouched. Streaming datasets
+    # skip eval.
+    if data_opts["streaming"]:
+        eval_loader = None
+    else:
+        from tpu_trainer.data.text import TextDataLoader
+
+        eval_loader = TextDataLoader(
+            train.dataset, rows,
+            process_index=trainer.process_index,
+            process_count=trainer.process_count,
+            seed=train.seed,
+        )
+    return train, eval_loader
+
+
+def run_training(argv=None, mode: str = "ddp") -> int:
+    args = build_parser(mode).parse_args(argv)
+    import os
+
+    import jax
+
+    platform = args.device or os.environ.get("JAX_PLATFORMS")
+    if platform:
+        # Honor the platform choice even when a site hook pre-registered an
+        # accelerator plugin (same workaround as tests/conftest.py).
+        jax.config.update("jax_platforms", platform)
+    mesh_lib.initialize_distributed(auto=args.multihost)
+
+    model_config, training_config, parallel_config, data_opts = resolve_configs(
+        args, mode
+    )
+    trainer = Trainer(model_config, training_config, parallel_config)
+    main = trainer.is_main_process
+    if main:
+        print(f"mode={mode} strategy={trainer.strategy} "
+              f"mesh={dict(trainer.mesh.shape)} devices={jax.device_count()} "
+              f"processes={trainer.process_count}")
+        print(f"model: {model_config.num_parameters():,} params | "
+              f"global batch {trainer.global_batch_size} seqs x "
+              f"{training_config.max_seq_len} tokens")
+
+    # --- resume (SURVEY.md §5.3: actually wired) -----------------------
+    state = None
+    tokens_seen = 0
+    resume_path = training_config.resume_from
+    if resume_path is None and data_opts["auto_resume"]:
+        resume_path = ckpt_lib.latest_checkpoint(training_config.checkpoint_dir)
+    if resume_path:
+        state, meta = ckpt_lib.restore_checkpoint(resume_path, trainer)
+        tokens_seen = meta.get("tokens_seen", 0)
+        if main:
+            print(f"resumed from {resume_path} at step {int(state.step)}")
+    else:
+        state = trainer.init_state()
+
+    train_loader, eval_loader = build_dataloaders(data_opts, trainer, model_config)
+
+    logger = MetricLogger(
+        model_config,
+        tokens_per_step=trainer.tokens_per_step,
+        log_interval=training_config.log_interval,
+        jsonl_path=data_opts["metrics_jsonl"],
+        is_main_process=main,
+    )
+    logger.tokens_seen = tokens_seen
+
+    # --- preemption handler (TPU maintenance SIGTERM) ------------------
+    preempted = {"hit": False}
+
+    def _on_sigterm(signum, frame):
+        preempted["hit"] = True
+
+    old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    def save(tag: str = ""):
+        path = ckpt_lib.save_checkpoint(
+            training_config.checkpoint_dir, state,
+            model_config=model_config, training_config=training_config,
+            tokens_seen=logger.tokens_seen,
+        )
+        if main:
+            print(f"saved checkpoint{' (' + tag + ')' if tag else ''}: {path}")
+
+    def run_eval():
+        if eval_loader is None:
+            return
+        losses = []
+        for i, batch in enumerate(eval_loader):
+            if i >= data_opts["eval_batches"]:
+                break
+            losses.append(float(trainer.eval_step(state, batch)))
+        if losses and main:
+            print(f"eval | step {int(state.step):>6d} | "
+                  f"loss {float(np.mean(losses)):.4f} ({len(losses)} batches)")
+
+    # --- the step loop (reference ddp_trainer.py:582-616) --------------
+    data_iter = iter(train_loader)
+
+    def next_batch():
+        nonlocal data_iter
+        try:
+            return next(data_iter)
+        except StopIteration:
+            data_iter = iter(train_loader)  # new epoch
+            try:
+                return next(data_iter)
+            except StopIteration:
+                raise SystemExit(
+                    "the dataset yields zero batches for this configuration: "
+                    "it is smaller than one global batch stride "
+                    f"(batch_size x grad_accum x data shards = "
+                    f"{trainer.global_batch_size} sequences of "
+                    f"{training_config.max_seq_len} tokens). Use a larger "
+                    "dataset or reduce batch_size/grad_accum."
+                ) from None
+
+    start_step = int(state.step)
+    step = start_step
+    try:
+        for step in range(start_step, training_config.max_steps):
+            batch = next_batch()
+            state, metrics = trainer.train_step(state, batch)
+            logger.log(step, metrics)
+            if (step + 1) % training_config.eval_interval == 0:
+                run_eval()
+            if (step + 1) % training_config.save_interval == 0:
+                save()
+            # The preempt decision must be unanimous: the checkpoint save is
+            # a collective, so one host's SIGTERM pulls every host in.
+            if mesh_lib.global_any(preempted["hit"]):
+                if main:
+                    print("SIGTERM received: checkpointing and exiting")
+                save("preempt")
+                return 143
+        save("final")
+        run_eval()
+    finally:
+        signal.signal(signal.SIGTERM, old_handler)
+        logger.close()
+    if main:
+        print(f"done: {step + 1 - start_step} steps this run, "
+              f"{logger.tokens_seen:,} tokens total")
+    return 0

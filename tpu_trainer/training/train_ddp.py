@@ -1,0 +1,21 @@
+"""DDP training entry point (↔ reference ``src/training/ddp_trainer.py``).
+
+Data parallelism the TPU way: params replicated, batch sharded over the
+``data`` mesh axis, gradient all-reduce inserted by the XLA SPMD partitioner
+(SURVEY.md C9). Run::
+
+    python -m tpu_trainer.training.train_ddp --model_size small --max_steps 50
+
+or via ``scripts/train_ddp.sh``.
+"""
+
+import sys
+
+from tpu_trainer.training.cli import run_training
+
+def main(argv=None) -> int:
+    return run_training(argv, mode="ddp")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,6 +134,15 @@ class Trainer:
             in_shardings=(self.state_shardings, self.batch_sharding),
             out_shardings=(self.state_shardings, None),
         )
+        eval_batch_sharding = NamedSharding(
+            self.mesh, mesh_lib.batch_spec_2d()
+        )
+        self._eval_jit = jax.jit(
+            self._eval_step,
+            in_shardings=(self.state_shardings, eval_batch_sharding),
+            out_shardings=None,
+        )
+        self._eval_batch_sharding = eval_batch_sharding
 
     # --- rank discovery (↔ reference rank/world_size, ddp_trainer.py:101-103)
     @property
@@ -219,6 +228,22 @@ class Trainer:
             batch = self.put_batch(batch)
         return self._step_jit(state, batch)
 
+    def eval_step(self, state: TrainState, batch) -> jax.Array:
+        """Forward-only mean loss on one ``[rows, seq]`` batch (deterministic,
+        no dropout) — the eval loop the reference's dead ``eval_interval``
+        promised (``ddp_trainer.py:52``, SURVEY.md §0.1)."""
+        if not isinstance(batch, jax.Array):
+            local = np.asarray(batch)
+            n, seq = local.shape
+            batch = jax.make_array_from_process_local_data(
+                self._eval_batch_sharding, local, (n * self.process_count, seq)
+            )
+        return self._eval_jit(state, batch)
+
+    def _eval_step(self, state: TrainState, batch: jax.Array):
+        _, loss = self.model.apply({"params": state.params}, batch, labels=batch)
+        return loss
+
     def _train_step(self, state: TrainState, batch: jax.Array):
         cfg = self.training_config
         accum = cfg.gradient_accumulation_steps
@@ -235,20 +260,28 @@ class Trainer:
             return loss * scale, loss
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        zero_grads = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-        )
 
-        def micro_step(carry, micro):
-            grads_acc, loss_acc, rng = carry
-            rng, sub = jax.random.split(rng)
-            (_, loss), grads = grad_fn(state.params, micro, sub, state.loss_scale)
-            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-            return (grads_acc, loss_acc + loss, rng), None
+        if accum == 1:
+            # No accumulation buffer — one backward, grads consumed in place.
+            new_rng, sub = jax.random.split(state.rng)
+            (_, loss_sum), grads = grad_fn(
+                state.params, batch[0], sub, state.loss_scale
+            )
+        else:
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
 
-        (grads, loss_sum, new_rng), _ = jax.lax.scan(
-            micro_step, (zero_grads, jnp.zeros((), jnp.float32), state.rng), batch
-        )
+            def micro_step(carry, micro):
+                grads_acc, loss_acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                (_, loss), grads = grad_fn(state.params, micro, sub, state.loss_scale)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                return (grads_acc, loss_acc + loss, rng), None
+
+            (grads, loss_sum, new_rng), _ = jax.lax.scan(
+                micro_step, (zero_grads, jnp.zeros((), jnp.float32), state.rng), batch
+            )
         # Mean over micro-steps and undo the loss scale; then pin the grads to
         # their ZeRO sharding (the reduce-scatter point under zero2/zero3).
         denom = accum * state.loss_scale

@@ -1,0 +1,176 @@
+"""Metrics / logging / observability (SURVEY.md §5.5, C30/C31).
+
+The reference promised ``src/utils/logging.py`` in its README structure but
+never wrote it (``README.md:51``, SURVEY.md §0.1); its real observability is
+rank-0 ``print`` with a cumulative-average tokens/sec (``ddp_trainer.py:600-609``
+— SURVEY.md §2.1 b6) plus CUDA memory stats (``fsdp_trainer.py:496-505``).
+
+This module is the real thing, TPU-native:
+
+- **windowed** tokens/sec (rate since the last log line, not since t0 — fixes
+  b6) plus tokens/sec/chip;
+- **MFU** against the chip's peak bf16 FLOPs (the ≥40% north star, BASELINE.md);
+- device memory stats via ``device.memory_stats()`` (↔ ``torch.cuda.memory_*``);
+- pluggable sinks: stdout table + JSONL file; emission is host-0 only, like
+  the reference's rank-0 gating.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import IO, Optional
+
+import jax
+
+from tpu_trainer.models.config import GPTConfig
+
+# Peak dense bf16 FLOP/s per chip, by device_kind substring (public figures).
+_PEAK_FLOPS = {
+    "v6": 918e12,        # Trillium (v6e)
+    "v5p": 459e12,
+    "v5e": 197e12,       # aka v5 lite
+    "v5lite": 197e12,    # device_kind "TPU v5 lite"
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+_DEFAULT_PEAK = 275e12   # assume v4 when the kind string is unrecognized
+
+
+def device_peak_flops(device: Optional[jax.Device] = None) -> float:
+    """Peak bf16 FLOP/s of one chip (best-effort from device_kind)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, flops in _PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return _DEFAULT_PEAK
+
+
+def flops_per_token(config: GPTConfig) -> float:
+    """Training FLOPs per token: 6*N for parameter matmuls (fwd + bwd) plus
+    12*L*S*H for the attention score/value matmuls (PaLM-appendix convention,
+    full S^2 — not halved for causality)."""
+    n = config.num_parameters()
+    attn = 12 * config.num_layers * config.max_seq_len * config.hidden_size
+    return 6.0 * n + attn
+
+
+def mfu(
+    tokens_per_sec: float,
+    config: GPTConfig,
+    n_chips: Optional[int] = None,
+    peak_flops: Optional[float] = None,
+) -> float:
+    """Model FLOPs utilization: achieved model FLOP/s over peak hardware FLOP/s."""
+    n_chips = n_chips if n_chips is not None else jax.device_count()
+    peak = peak_flops if peak_flops is not None else device_peak_flops()
+    return tokens_per_sec * flops_per_token(config) / (n_chips * peak)
+
+
+def memory_stats(device: Optional[jax.Device] = None) -> dict:
+    """Per-device HBM stats in bytes (↔ reference ``get_memory_stats``,
+    ``fsdp_trainer.py:496-505``). Empty dict where the backend has none (CPU)."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }
+
+
+class MetricLogger:
+    """Step-metrics logger with windowed rates and pluggable sinks.
+
+    Usage::
+
+        logger = MetricLogger(model_config, tokens_per_step=..., jsonl_path=...)
+        for step ...:
+            state, metrics = trainer.train_step(...)
+            logger.log(step, metrics)     # emits every log_interval steps
+
+    Only host 0 emits (reference rank-0 gating, ``ddp_trainer.py:600``);
+    other hosts keep counters but write nothing.
+    """
+
+    def __init__(
+        self,
+        model_config: Optional[GPTConfig] = None,
+        *,
+        tokens_per_step: int = 0,
+        log_interval: int = 1,
+        jsonl_path: Optional[str] = None,
+        stdout: bool = True,
+        is_main_process: Optional[bool] = None,
+    ):
+        self.model_config = model_config
+        self.tokens_per_step = tokens_per_step
+        self.log_interval = max(1, log_interval)
+        self.is_main = (
+            is_main_process if is_main_process is not None else jax.process_index() == 0
+        )
+        self.stdout = stdout and self.is_main
+        self._jsonl: Optional[IO[str]] = None
+        if jsonl_path and self.is_main:
+            os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)), exist_ok=True)
+            self._jsonl = open(jsonl_path, "a", buffering=1)
+        self.tokens_seen = 0
+        self._t0 = time.perf_counter()
+        self._window_t = self._t0
+        self._window_tokens = 0
+        self._n_chips = jax.device_count()
+        self._peak = device_peak_flops()
+        self._on_accelerator = jax.devices()[0].platform != "cpu"
+
+    def log(self, step: int, metrics: dict, extra: Optional[dict] = None) -> Optional[dict]:
+        """Record one step; emit (and return) a record every ``log_interval``."""
+        self.tokens_seen += self.tokens_per_step
+        self._window_tokens += self.tokens_per_step
+        if (step + 1) % self.log_interval != 0:
+            return None
+
+        now = time.perf_counter()
+        window_s = max(now - self._window_t, 1e-9)
+        tok_per_sec = self._window_tokens / window_s   # windowed, not cumulative (b6)
+        record = {
+            "step": int(step),
+            "loss": float(metrics.get("loss", float("nan"))),
+            "lr": float(metrics.get("lr", 0.0)),
+            "grad_norm": float(metrics.get("grad_norm", 0.0)),
+            "tokens_seen": int(self.tokens_seen),
+            "tokens_per_sec": round(tok_per_sec, 1),
+            "tokens_per_sec_per_chip": round(tok_per_sec / self._n_chips, 1),
+            "elapsed_s": round(now - self._t0, 3),
+        }
+        if self.model_config is not None and self._on_accelerator:
+            record["mfu"] = round(
+                mfu(tok_per_sec, self.model_config, self._n_chips, self._peak), 4
+            )
+        mem = memory_stats()
+        if mem["peak_bytes_in_use"]:
+            record["peak_mem_gb"] = round(mem["peak_bytes_in_use"] / 2**30, 3)
+        if extra:
+            record.update(extra)
+
+        self._window_t = now
+        self._window_tokens = 0
+        if self.stdout:
+            parts = [f"step {record['step']:>6d}", f"loss {record['loss']:.4f}",
+                     f"lr {record['lr']:.2e}",
+                     f"{record['tokens_per_sec']:,.0f} tok/s"]
+            if "mfu" in record:
+                parts.append(f"mfu {record['mfu']:.1%}")
+            if "peak_mem_gb" in record:
+                parts.append(f"mem {record['peak_mem_gb']:.2f}GB")
+            print(" | ".join(parts), flush=True)
+        if self._jsonl:
+            self._jsonl.write(json.dumps(record) + "\n")
+        return record
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None
