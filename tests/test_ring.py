@@ -1,0 +1,137 @@
+"""Ring attention (sequence parallelism) tests — SURVEY.md §5.7 headroom.
+
+The single-device jnp attention (``ops/attention.py``) is the numerics
+oracle, as for the flash kernel: ring attention over a 4-way sequence axis
+must reproduce it in values and gradients, and an end-to-end train step on a
+``sequence``-sharded mesh must match the DDP step's loss exactly (same math,
+different placement).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.ops.attention import reference_attention
+from tpu_trainer.ops.ring import SEQ_AXIS, ring_attention
+from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+
+def _seq_mesh(sp: int) -> Mesh:
+    return make_mesh(MeshConfig(data=-1, fsdp=1, sequence=sp))
+
+
+def _rand_qkv(key, b, s, h, d):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (b, s, h, d)
+    return (
+        jax.random.normal(kq, shape),
+        jax.random.normal(kk, shape),
+        jax.random.normal(kv, shape),
+    )
+
+
+class TestRingNumerics:
+    def test_forward_matches_reference(self):
+        mesh = _seq_mesh(4)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 64, 2, 16)
+        expected = reference_attention(q, k, v)
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+        np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+    def test_sp1_is_plain_attention(self):
+        mesh = _seq_mesh(1)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 32, 2, 8)
+        expected = reference_attention(q, k, v)
+        got = ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_reference(self):
+        mesh = _seq_mesh(4)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 64, 2, 16)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(jnp.sin(ring_attention(q, k, v, mesh)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(reference_attention(q, k, v)))
+
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, expected, name in zip(g_ring, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, expected, atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_causality_across_ring(self):
+        # Changing a future K/V chunk must not affect earlier outputs, even
+        # across shard boundaries.
+        mesh = _seq_mesh(4)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 64, 1, 8)
+        out1 = ring_attention(q, k, v, mesh)
+        k2 = k.at[:, 48:].set(7.0)   # last ring chunk
+        v2 = v.at[:, 48:].set(-7.0)
+        out2 = ring_attention(q, k2, v2, mesh)
+        np.testing.assert_allclose(out1[:, :48], out2[:, :48], atol=1e-6)
+
+    def test_indivisible_seq_raises(self):
+        mesh = _seq_mesh(4)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(4), 1, 30, 1, 8)
+        with pytest.raises(ValueError, match="not divisible"):
+            ring_attention(q, k, v, mesh)
+
+
+class TestSequenceParallelTraining:
+    def _tiny_config(self):
+        return GPTConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+            max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+            use_flash_attention=False, dtype="float32",
+        )
+
+    def _train_cfg(self, batch_size):
+        return TrainingConfig(
+            batch_size=batch_size, max_seq_len=64,
+            gradient_accumulation_steps=1, mixed_precision="fp32",
+            warmup_steps=2, max_steps=10,
+        )
+
+    def test_sp_losses_match_ddp(self):
+        model_cfg = self._tiny_config()
+        # Identical global batch (8 rows) under every mesh: per-shard
+        # batch_size = 8 / dp_size.
+        batch = np.random.default_rng(0).integers(
+            0, 128, (8, 64), dtype=np.int32
+        )
+
+        losses = {}
+        for name, mesh_cfg, dp in [
+            ("ddp", MeshConfig(data=-1, fsdp=1), 8),
+            ("sp4", MeshConfig(data=2, fsdp=1, sequence=4), 2),
+            ("fsdp2_sp4", MeshConfig(data=1, fsdp=2, sequence=4), 2),
+        ]:
+            strategy = "zero3" if "fsdp" in name else "replicated"
+            trainer = Trainer(
+                model_cfg, self._train_cfg(8 // dp),
+                ParallelConfig(mesh=mesh_cfg, sharding_strategy=strategy),
+            )
+            state = trainer.init_state(seed=0)
+            for _ in range(3):
+                state, metrics = trainer.train_step(state, batch)
+            losses[name] = float(metrics["loss"])
+        assert losses["ddp"] == pytest.approx(losses["sp4"], rel=1e-5)
+        assert losses["ddp"] == pytest.approx(losses["fsdp2_sp4"], rel=1e-5)
+
+    def test_sp_rejects_indivisible_seq_len(self):
+        import dataclasses as dc
+
+        cfg = dc.replace(self._train_cfg(batch_size=1), max_seq_len=60)
+        with pytest.raises(ValueError, match="not divisible"):
+            Trainer(
+                self._tiny_config(), cfg,
+                ParallelConfig(mesh=MeshConfig(data=1, fsdp=1, sequence=8)),
+            )

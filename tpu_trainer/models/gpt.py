@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.ops import ring
 from tpu_trainer.ops.attention import flash_attention, reference_attention
 
 
@@ -127,13 +128,25 @@ class CausalSelfAttention(nn.Module):
 
         needs_rng = cfg.attention_dropout > 0.0 and not deterministic
         dropout_rng = self.make_rng("dropout") if needs_rng else None
-        attn_fn = flash_attention if cfg.use_flash_attention else reference_attention
-        out = attn_fn(
-            q, k, v,
-            dropout_rate=cfg.attention_dropout,
-            deterministic=deterministic,
-            dropout_rng=dropout_rng,
-        )
+        sp_ctx = ring.current_context()
+        if sp_ctx is not None and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1:
+            # Sequence parallelism: K/V ring over the mesh's sequence axis.
+            if needs_rng:
+                raise NotImplementedError(
+                    "attention dropout is not supported under ring attention; "
+                    "set attention_dropout=0 for sequence parallelism"
+                )
+            out = ring.ring_attention(q, k, v, sp_ctx.mesh, sp_ctx.axis_name)
+        else:
+            attn_fn = (
+                flash_attention if cfg.use_flash_attention else reference_attention
+            )
+            out = attn_fn(
+                q, k, v,
+                dropout_rate=cfg.attention_dropout,
+                deterministic=deterministic,
+                dropout_rng=dropout_rng,
+            )
 
         out = out.reshape(b, s, cfg.hidden_size)
         out = dense(name="o_proj")(out)

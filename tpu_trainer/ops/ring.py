@@ -1,0 +1,147 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+Long-context sequence/context parallelism — a capability the reference lacks
+entirely (SURVEY.md §5.7: no ring/blockwise/Ulysses anywhere) but that shapes
+a TPU-native design from the start: sequences longer than one chip's memory
+are sharded over a ``sequence`` mesh axis, and K/V shards rotate around the
+ring over ICI while each device accumulates its queries' attention with an
+online (running max / running sum) softmax — the same math as the flash
+kernel (``ops/flash.py``), lifted one level up the memory hierarchy
+(HBM-of-one-chip → HBM-of-the-ring).
+
+Mechanics:
+
+- Executed under ``shard_map`` over the ``sequence`` axis: each device holds
+  ``[b, seq/sp, h, d]`` of q, k, v.
+- ``sp`` steps; at step t a device holds the K/V chunk of device
+  ``(i - t) % sp``, combines it into its partial (m, l, acc), then sends the
+  chunk to its right neighbor with ``lax.ppermute`` (XLA overlaps the
+  transfer with the next step's compute).
+- Causality by *global* position: chunk offsets ``i*sl`` (queries) and
+  ``src*sl`` (keys). Fully-future chunks contribute zero through the mask —
+  every device runs the same step count (uniform SPMD control flow).
+- Differentiable by construction (pure jnp + ppermute, which has a
+  well-defined transpose), so the backward pass needs no custom VJP.
+
+The reference's only long-sequence levers are gradient checkpointing and a
+fixed 1024 context (SURVEY.md §5.7); this module is the headroom beyond.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "sequence"
+_NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class SequenceParallelContext:
+    mesh: Mesh
+    axis_name: str = SEQ_AXIS
+
+
+_ACTIVE: Optional[SequenceParallelContext] = None
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """Trace-time context: while active, the model's attention dispatch routes
+    through ``ring_attention`` over ``mesh``'s ``axis_name`` axis. (Static —
+    consumed during jit tracing, not at runtime.)"""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = SequenceParallelContext(mesh, axis_name)
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+def current_context() -> Optional[SequenceParallelContext]:
+    return _ACTIVE
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, sp: int, scale: float):
+    """Per-device body under shard_map. q, k, v: local ``[b, sl, h, d]``."""
+    b, sl, h, d = q.shape
+    idx = lax.axis_index(axis_name)
+    q32 = q.astype(jnp.float32) * scale
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
+
+    m0 = jnp.full((b, h, sl, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(t, carry):
+        m, l, acc, k_t, v_t = carry
+        src = (idx - t) % sp  # global chunk id of the K/V currently held
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_t.astype(jnp.float32))
+        # Global causal mask: query position idx*sl + r, key src*sl + c.
+        allowed = (idx * sl + rows) >= (src * sl + cols)
+        s = jnp.where(allowed[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                     # [b,h,q,k]; 0 where masked
+        alpha = jnp.exp(m - m_new)                 # [b,h,q,1]
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        contrib = jnp.einsum("bhqk,bkhd->bqhd", p, v_t.astype(jnp.float32))
+        acc_new = acc * alpha[:, :, :, 0].transpose(0, 2, 1)[..., None] + contrib
+        k_n, v_n = lax.ppermute((k_t, v_t), axis_name, perm=perm)
+        return m_new, l_new, acc_new, k_n, v_n
+
+    m, l, acc, _, _ = lax.fori_loop(0, sp, step, (m0, l0, acc0, k, v))
+    norm = l[:, :, :, 0].transpose(0, 2, 1)[..., None]   # [b, sl, h, 1]
+    return (acc / norm).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis_name: str = SEQ_AXIS,
+) -> jax.Array:
+    """Causal ring attention; global BSHD in/out, seq sharded over ``axis_name``.
+
+    Requires ``seq % axis_size == 0``. With ``axis_size == 1`` this is plain
+    blockwise attention (one step, no communication).
+    """
+    b, s, h, d = q.shape
+    sp = mesh.shape[axis_name]
+    if s % sp != 0:
+        raise ValueError(f"seq {s} not divisible by {axis_name} axis size {sp}")
+    scale = 1.0 / math.sqrt(d)
+    # Keep the surrounding activation sharding across the shard_map boundary:
+    # batch stays split over data x fsdp and heads over tensor (attention is
+    # independent across both), so no all-gather is forced on entry. Axes
+    # that don't divide the dim (tiny test batches) fall back to replicated.
+    from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+
+    batch_axes = (DATA_AXIS, FSDP_AXIS)
+    dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    b_spec = batch_axes if (dp > 1 and b % dp == 0) else None
+    tp = mesh.shape[TENSOR_AXIS]
+    h_spec = TENSOR_AXIS if (tp > 1 and h % tp == 0) else None
+    spec = P(b_spec, axis_name, h_spec, None)
+    fn = shard_map(
+        lambda q, k, v: _ring_attention_local(
+            q, k, v, axis_name=axis_name, sp=sp, scale=scale
+        ),
+        mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)

@@ -39,8 +39,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+SEQUENCE_AXIS = "sequence"
 TENSOR_AXIS = "tensor"
-MESH_AXES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS)
+MESH_AXES = (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,10 +53,11 @@ class MeshConfig:
 
     data: int = -1
     fsdp: int = 1
+    sequence: int = 1
     tensor: int = 1
 
     def resolve(self, n_devices: int) -> tuple:
-        sizes = [self.data, self.fsdp, self.tensor]
+        sizes = [self.data, self.fsdp, self.sequence, self.tensor]
         n_auto = sum(1 for s in sizes if s == -1)
         if n_auto > 1:
             raise ValueError("at most one mesh axis may be -1")
@@ -136,13 +138,13 @@ def batch_spec() -> P:
     """PartitionSpec for a ``[accum, batch, seq]`` micro-batched step input:
     batch is sharded over data × fsdp jointly (every device holds a distinct
     slice of the global batch — the FSDP world is also the data world, as in
-    torch FSDP)."""
-    return P(None, (DATA_AXIS, FSDP_AXIS), None)
+    torch FSDP); the sequence dim shards over the ring-attention axis."""
+    return P(None, (DATA_AXIS, FSDP_AXIS), SEQUENCE_AXIS)
 
 
 def batch_spec_2d() -> P:
     """PartitionSpec for a plain ``[batch, seq]`` batch (eval/inference)."""
-    return P((DATA_AXIS, FSDP_AXIS), None)
+    return P((DATA_AXIS, FSDP_AXIS), SEQUENCE_AXIS)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -1,7 +1,8 @@
 """Parameter / optimizer-state sharding rules (GSPMD).
 
 The TPU-native equivalent of the reference's two parallelism strategies
-(SURVEY.md C9/C10):
+(SURVEY.md C9/C10), plus tensor parallelism (absent upstream — an
+aspirational README bullet, ``README.md:9``; here a working mesh axis):
 
 - **DDP** (reference ``ddp_trainer.py:167-172``): params and optimizer state
   replicated; the batch sharded over the data axes. XLA's SPMD partitioner
@@ -19,6 +20,11 @@ The TPU-native equivalent of the reference's two parallelism strategies
   (HYBRID_SHARD is docstring-only/broken in the reference —
   ``fsdp_trainer.py:258-261`` vs the strategy dict ``:269-273``; here it is
   simply ``data > 1 and fsdp > 1``.)
+- **TP (Megatron-style)**: when the mesh's ``tensor`` axis is > 1, the
+  per-layer projections split column-/row-parallel via path rules
+  (``_TENSOR_RULES``); GSPMD emits the all-reduce after each row-parallel
+  matmul. No explicit collectives appear anywhere — TP is purely a change of
+  PartitionSpec, composable with every ZeRO mode.
 
 The all-gather (param use) and reduce-scatter (grad reduction) that torch
 FSDP issues per wrapped module are emitted automatically by the XLA SPMD
@@ -26,16 +32,16 @@ partitioner, with overlap handled by the latency-hiding scheduler — the
 analogue of ``backward_prefetch``/``limit_all_gathers``
 (``fsdp_trainer.py:296,304-307``).
 
-Sharding rule: for each array leaf, shard the **largest** dimension that is
-divisible by the fsdp axis size (ties → later dim). This is shape-driven, so
-one rule covers params, grads, and Adam's mu/nu (whose trees mirror params).
-A ``tensor`` axis (Megatron-style op sharding) is reserved in the mesh; rules
-for it live in ``tensor_rules`` and activate when ``tensor > 1``.
+FSDP rule: for each array leaf, shard the **largest** dimension that is
+divisible by the fsdp axis size and not already tensor-sharded (ties → later
+dim). Shape-driven, so one rule covers params, grads, and Adam's mu/nu
+(whose trees mirror params — path matching uses suffix match, which survives
+the optax state nesting).
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -55,6 +61,23 @@ STRATEGY_ALIASES = {
     "ddp": "replicated",
 }
 
+# Megatron-style tensor-parallel placement, by parameter-path suffix.
+# Column-parallel = shard the output dim (last); row-parallel = shard the
+# input dim (second to last); the row-parallel matmuls (o_proj, down_proj)
+# are where GSPMD inserts the TP all-reduce. The tied embedding shards its
+# hidden dim (vocab 50257 is not divisible by practical axis sizes), making
+# ``embed.attend`` a row-parallel matmul too.
+_TENSOR_RULES: List[Tuple[Tuple[str, ...], int]] = [
+    (("attention", "q_proj", "kernel"), -1),
+    (("attention", "k_proj", "kernel"), -1),
+    (("attention", "v_proj", "kernel"), -1),
+    (("attention", "o_proj", "kernel"), -2),
+    (("mlp", "gate_proj", "kernel"), -1),
+    (("mlp", "up_proj", "kernel"), -1),
+    (("mlp", "down_proj", "kernel"), -2),
+    (("embed_tokens", "embedding"), -1),
+]
+
 
 def canonical_strategy(name: str) -> str:
     if name not in STRATEGY_ALIASES:
@@ -64,55 +87,100 @@ def canonical_strategy(name: str) -> str:
     return STRATEGY_ALIASES[name]
 
 
+def _path_keys(path) -> Tuple[str, ...]:
+    return tuple(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", "")))) for p in path
+    )
+
+
+def _tensor_dim(path_keys: Tuple[str, ...], shape, tensor_size: int) -> Optional[int]:
+    """Dim to shard over the tensor axis for this param path, or None."""
+    if tensor_size <= 1:
+        return None
+    for suffix, dim in _TENSOR_RULES:
+        if path_keys[-len(suffix):] == suffix:
+            d = dim % len(shape)
+            if shape[d] % tensor_size == 0:
+                return d
+            return None
+    return None
+
+
 def fsdp_spec(shape, fsdp_size: int) -> P:
-    """Shard the largest fsdp-divisible dim over the fsdp axis."""
-    if fsdp_size <= 1 or not shape:
+    """Shape-only FSDP rule: shard the largest fsdp-divisible dim (ties →
+    later dim); replicate when nothing divides."""
+    return _leaf_spec((), shape, fsdp_size=fsdp_size, tensor_size=1,
+                      shard_fsdp=True)
+
+
+def _leaf_spec(path_keys, shape, *, fsdp_size: int, tensor_size: int,
+               shard_fsdp: bool) -> P:
+    """Combined TP + FSDP PartitionSpec for one array leaf."""
+    if not shape:
         return P()
-    best: Optional[int] = None
-    for i, d in enumerate(shape):
-        if d % fsdp_size == 0 and d >= fsdp_size:
-            if best is None or d >= shape[best]:
-                best = i
-    if best is None:
+    dims: List[Optional[str]] = [None] * len(shape)
+    tdim = _tensor_dim(path_keys, shape, tensor_size)
+    if tdim is not None:
+        dims[tdim] = TENSOR_AXIS
+    if shard_fsdp and fsdp_size > 1:
+        best: Optional[int] = None
+        for i, d in enumerate(shape):
+            if dims[i] is None and d % fsdp_size == 0 and d >= fsdp_size:
+                if best is None or d >= shape[best]:
+                    best = i
+        if best is not None:
+            dims[best] = FSDP_AXIS
+    if all(d is None for d in dims):
         return P()
-    spec = [None] * len(shape)
-    spec[best] = FSDP_AXIS
-    return P(*spec)
+    return P(*dims)
+
+
+def _specs_for_tree(tree: Any, mesh: Mesh, *, shard_fsdp: bool) -> Any:
+    fsdp_size = mesh.shape[FSDP_AXIS]
+    tensor_size = mesh.shape[TENSOR_AXIS]
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: _leaf_spec(
+            _path_keys(path), getattr(x, "shape", ()),
+            fsdp_size=fsdp_size, tensor_size=tensor_size, shard_fsdp=shard_fsdp,
+        ),
+        tree,
+    )
 
 
 def params_specs(params: Any, mesh: Mesh, strategy: str) -> Any:
-    """PartitionSpec tree for model parameters under a strategy."""
+    """PartitionSpec tree for model parameters under a strategy.
+
+    TP placement applies in every strategy (a TP-sharded param is never
+    replicated over ``tensor``); the fsdp axis applies only under zero3.
+    """
     strategy = canonical_strategy(strategy)
-    fsdp_size = mesh.shape[FSDP_AXIS]
-    if strategy in ("replicated", "zero2"):
-        return jax.tree_util.tree_map(lambda _: P(), params)
-    return jax.tree_util.tree_map(lambda x: fsdp_spec(x.shape, fsdp_size), params)
+    return _specs_for_tree(params, mesh, shard_fsdp=strategy == "zero3")
 
 
 def opt_state_specs(opt_state: Any, mesh: Mesh, strategy: str) -> Any:
     """PartitionSpec tree for optimizer state.
 
     zero2 and zero3 both shard the (param-shaped) Adam moments; scalars (step
-    counts) stay replicated. ``opt_state`` may be a tree of concrete arrays or
-    of ShapeDtypeStructs (from ``jax.eval_shape``).
+    counts) stay replicated. The moments live nested inside optax state, but
+    suffix-matching the param path still applies the TP rules correctly.
+    ``opt_state`` may be a tree of arrays or of ShapeDtypeStructs.
     """
     strategy = canonical_strategy(strategy)
-    fsdp_size = mesh.shape[FSDP_AXIS]
-    if strategy == "replicated":
-        return jax.tree_util.tree_map(lambda _: P(), opt_state)
-    return jax.tree_util.tree_map(
-        lambda x: fsdp_spec(x.shape, fsdp_size) if getattr(x, "ndim", 0) >= 1 else P(),
-        opt_state,
+    return _specs_for_tree(
+        opt_state, mesh, shard_fsdp=strategy in ("zero2", "zero3")
     )
 
 
 def grads_specs(params: Any, mesh: Mesh, strategy: str) -> Any:
-    """PartitionSpec tree for gradients (reduce-scatter target under ZeRO)."""
+    """PartitionSpec tree for gradients (reduce-scatter target under ZeRO).
+
+    Gradients of TP-sharded params carry the same tensor dims in every
+    strategy; the fsdp axis applies under zero2/zero3.
+    """
     strategy = canonical_strategy(strategy)
-    fsdp_size = mesh.shape[FSDP_AXIS]
-    if strategy == "replicated":
-        return jax.tree_util.tree_map(lambda _: P(), params)
-    return jax.tree_util.tree_map(lambda x: fsdp_spec(x.shape, fsdp_size), params)
+    return _specs_for_tree(
+        params, mesh, shard_fsdp=strategy in ("zero2", "zero3")
+    )
 
 
 def to_shardings(spec_tree: Any, mesh: Mesh) -> Any:

@@ -41,7 +41,12 @@ from tpu_trainer.parallel import mesh as mesh_lib
 from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.training.trainer import ParallelConfig, Trainer
 from tpu_trainer.utils import checkpoint as ckpt_lib
+from tpu_trainer.utils import guards, profiling
 from tpu_trainer.utils.logging import MetricLogger
+
+# Steps between cross-host preemption votes (each vote is a collective, so
+# it must run at a cadence every host reaches at the same step).
+_PREEMPT_VOTE_INTERVAL = 10
 
 _SHARDING_CHOICES = [
     "FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD",
@@ -93,9 +98,21 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--no_auto_resume", action="store_true", default=None)
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--seed", type=int, default=None)
+    # profiling (SURVEY.md §5.1) and numerics/divergence guards (§5.2)
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="capture a jax.profiler trace window to this dir")
+    p.add_argument("--profile_start", type=int, default=None,
+                   help="first traced step (default 5; lets compile pass)")
+    p.add_argument("--profile_steps", type=int, default=None,
+                   help="number of steps to trace (default 5)")
+    p.add_argument("--guard_interval", type=int, default=None,
+                   help="steps between finite-loss + cross-host sync checks "
+                        "(default 100; 0 disables)")
     # mesh / multi-host
     p.add_argument("--mesh_data", type=int, default=None)
     p.add_argument("--mesh_fsdp", type=int, default=None)
+    p.add_argument("--mesh_sequence", type=int, default=None,
+                   help="ring-attention sequence-parallel axis size")
     p.add_argument("--mesh_tensor", type=int, default=None)
     p.add_argument("--multihost", action="store_true", default=None,
                    help="force jax.distributed.initialize() autodetect")
@@ -253,6 +270,7 @@ def resolve_configs(args, mode: str):
     mesh_config = mesh_lib.MeshConfig(
         data=_pick(args.mesh_data, default_mesh.data),
         fsdp=_pick(args.mesh_fsdp, default_mesh.fsdp),
+        sequence=_pick(args.mesh_sequence, default_mesh.sequence),
         tensor=_pick(args.mesh_tensor, default_mesh.tensor),
     )
     parallel_config = ParallelConfig(mesh=mesh_config, sharding_strategy=strategy)
@@ -269,6 +287,10 @@ def resolve_configs(args, mode: str):
         "metrics_jsonl": args.metrics_jsonl,
         "eval_batches": _pick(args.eval_batches, 8),
         "auto_resume": not args.no_auto_resume,
+        "profile_dir": args.profile_dir,
+        "profile_start": _pick(args.profile_start, 5),
+        "profile_steps": _pick(args.profile_steps, 5),
+        "guard_interval": _pick(args.guard_interval, 100),
     }
     return model_config, training_config, parallel_config, data_opts
 
@@ -445,28 +467,52 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     "dataset or reduce batch_size/grad_accum."
                 ) from None
 
+    profiler = profiling.WindowedTrace(
+        data_opts["profile_dir"],
+        start=int(state.step) + data_opts["profile_start"],
+        num_steps=data_opts["profile_steps"],
+    )
+    guard_interval = data_opts["guard_interval"]
+
     start_step = int(state.step)
     step = start_step
     try:
         for step in range(start_step, training_config.max_steps):
+            profiler.step(step)
             batch = next_batch()
             state, metrics = trainer.train_step(state, batch)
-            logger.log(step, metrics)
-            if (step + 1) % training_config.eval_interval == 0:
+            record = logger.log(step, metrics)
+            if guard_interval and (step + 1) % guard_interval == 0:
+                loss = (record or {}).get("loss", float(metrics["loss"]))
+                guards.check_finite(step, loss)
+                guards.check_hosts_in_sync(step, loss)
+            eval_now = (training_config.eval_interval > 0
+                        and (step + 1) % training_config.eval_interval == 0)
+            if eval_now:
                 run_eval()
-            if (step + 1) % training_config.save_interval == 0:
+            if (training_config.save_interval > 0
+                    and (step + 1) % training_config.save_interval == 0):
                 save()
             # The preempt decision must be unanimous: the checkpoint save is
-            # a collective, so one host's SIGTERM pulls every host in.
-            if mesh_lib.global_any(preempted["hit"]):
+            # a collective, so one host's SIGTERM pulls every host in. The
+            # cross-host vote is itself a collective, so on pods it runs at a
+            # fixed cadence every host hits at the same step (not on the
+            # local flag, which would desynchronize the allgather).
+            vote_now = (trainer.process_count == 1
+                        or (step + 1) % _PREEMPT_VOTE_INTERVAL == 0)
+            if vote_now and mesh_lib.global_any(preempted["hit"]):
                 if main:
                     print("SIGTERM received: checkpointing and exiting")
                 save("preempt")
                 return 143
         save("final")
-        run_eval()
+        if not (training_config.eval_interval > 0
+                and step + 1 == training_config.max_steps
+                and (step + 1) % training_config.eval_interval == 0):
+            run_eval()  # skip only when the loop's last step just ran eval
     finally:
         signal.signal(signal.SIGTERM, old_handler)
+        profiler.close()
         logger.close()
     if main:
         print(f"done: {step + 1 - start_step} steps this run, "

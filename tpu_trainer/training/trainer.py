@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.models.gpt import GPT
+from tpu_trainer.ops import ring
 from tpu_trainer.parallel import mesh as mesh_lib
 from tpu_trainer.parallel import sharding as shard_lib
 from tpu_trainer.training.config import TrainingConfig
@@ -104,6 +105,37 @@ class Trainer:
         self.use_loss_scaling = training_config.mixed_precision == "fp16"
 
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(parallel_config.mesh)
+        self.sp_size = self.mesh.shape[mesh_lib.SEQUENCE_AXIS]
+        if self.sp_size > 1 and training_config.max_seq_len % self.sp_size != 0:
+            raise ValueError(
+                f"max_seq_len {training_config.max_seq_len} not divisible by "
+                f"sequence axis size {self.sp_size}"
+            )
+        n_proc = jax.process_count()
+        if n_proc > 1 and mesh_lib.dp_size(self.mesh) % n_proc != 0:
+            # Data loaders feed each host a disjoint row slice, which is only
+            # correct when the data shards partition the hosts. A sequence/
+            # tensor axis spanning hosts (dp_size < process_count) would need
+            # replicated-row feeding — not wired up yet; fail loudly instead
+            # of silently training on mismatched rows.
+            raise NotImplementedError(
+                f"data-shard count {mesh_lib.dp_size(self.mesh)} does not "
+                f"partition {n_proc} hosts; put sequence/tensor axes within "
+                f"a host, or grow data x fsdp to a multiple of the host count"
+            )
+        self.tp_size = self.mesh.shape[mesh_lib.TENSOR_AXIS]
+        if self.tp_size > 1:
+            if self.model_config.num_heads % self.tp_size != 0:
+                raise ValueError(
+                    f"num_heads {self.model_config.num_heads} not divisible "
+                    f"by tensor axis size {self.tp_size}"
+                )
+            if self.model_config.use_flash_attention:
+                # The Pallas kernel is not GSPMD-partitionable yet; under TP
+                # it would force replicated attention. Use the XLA path.
+                self.model_config = dataclasses.replace(
+                    self.model_config, use_flash_attention=False
+                )
         self.model = GPT(self.model_config)
         self.optimizer = make_optimizer(training_config)
 
@@ -241,8 +273,20 @@ class Trainer:
         return self._eval_jit(state, batch)
 
     def _eval_step(self, state: TrainState, batch: jax.Array):
-        _, loss = self.model.apply({"params": state.params}, batch, labels=batch)
+        with self._sp_context():
+            _, loss = self.model.apply(
+                {"params": state.params}, batch, labels=batch
+            )
         return loss
+
+    def _sp_context(self):
+        """Sequence-parallel (ring attention) trace context, when the mesh has
+        a non-trivial ``sequence`` axis."""
+        if self.sp_size > 1:
+            return ring.sequence_parallel(self.mesh)
+        import contextlib
+
+        return contextlib.nullcontext()
 
     def _train_step(self, state: TrainState, batch: jax.Array):
         cfg = self.training_config
@@ -250,13 +294,14 @@ class Trainer:
         assert batch.ndim == 3 and batch.shape[0] == accum
 
         def loss_fn(params, micro, rng, scale):
-            _, loss = self.model.apply(
-                {"params": params},
-                micro,
-                labels=micro,
-                train=True,
-                rngs={"dropout": rng},
-            )
+            with self._sp_context():
+                _, loss = self.model.apply(
+                    {"params": params},
+                    micro,
+                    labels=micro,
+                    train=True,
+                    rngs={"dropout": rng},
+                )
             return loss * scale, loss
 
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)

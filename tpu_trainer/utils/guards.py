@@ -1,0 +1,64 @@
+"""Numerics and cross-host consistency guards (SURVEY.md §5.2).
+
+The reference has no sanitizers or race detection of any kind; its implicit
+idioms are rank0-only writes and a post-save barrier. On TPU the device-level
+races are XLA's problem, but two real SPMD failure modes remain and are
+checked here:
+
+- **Non-finite loss** (data corruption, LR blowup, fp16 overflow past the
+  loss-scaler's floor): ``check_finite`` fails fast with the step number
+  instead of training into NaN for hours.
+- **Cross-host divergence** (the SPMD contract: every host must execute the
+  same program over the same global state — a divergent host corrupts
+  collectives silently): ``check_hosts_in_sync`` allgathers a per-host
+  ``(step, loss)`` fingerprint and raises on mismatch, the moral equivalent
+  of a TSAN assertion for the pod.
+
+Both are cheap (one scalar fetch / one tiny allgather) and run every
+``interval`` steps from the training CLI.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+
+
+class DivergenceError(RuntimeError):
+    pass
+
+
+def check_finite(step: int, loss: float) -> None:
+    """Raise if the loss is NaN/Inf (bf16/fp32 paths have no loss scaler to
+    absorb it; with fp16 the scaler skips the step before this sees it)."""
+    if not math.isfinite(loss):
+        raise FloatingPointError(
+            f"non-finite loss {loss} at step {step}: check data, learning "
+            f"rate, or use mixed_precision=bf16 (fp16 requires loss scaling)"
+        )
+
+
+def check_hosts_in_sync(step: int, loss: float, atol: float = 0.0) -> None:
+    """Verify every host agrees on (step, loss).
+
+    Under SPMD the loss is computed from globally-sharded arrays, so all
+    hosts must see bit-identical values; disagreement means a host diverged
+    (bad data sharding, nondeterministic op, or hardware fault) and its
+    collectives are corrupting the others.
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    mine = np.asarray([float(step), float(loss)], np.float64)
+    allv = multihost_utils.process_allgather(mine)  # [hosts, 2]
+    steps, losses = allv[:, 0], allv[:, 1]
+    if not np.all(steps == steps[0]) or not np.all(
+        np.abs(losses - losses[0]) <= atol
+    ):
+        raise DivergenceError(
+            f"cross-host divergence at step {step}: steps={steps.tolist()} "
+            f"losses={losses.tolist()} (host {jax.process_index()})"
+        )

@@ -1,0 +1,75 @@
+"""Profiling / tracing (SURVEY.md §5.1).
+
+The reference has no profiler integration — its learning guide merely *names*
+``torch.profiler`` as a debugging tip (``LEARNING_GUIDE.md:226``); measured
+observability is wall-clock prints. Here tracing is a first-class subsystem
+built on ``jax.profiler``:
+
+- ``trace(dir)`` — context manager capturing an XLA/TensorBoard trace
+  (HLO-level timeline incl. collective overlap — the tool for verifying that
+  GSPMD's all-gathers actually hide behind compute).
+- ``windowed_trace(dir, start, stop)`` — step-driven wrapper used by the
+  training CLI (``--profile_dir``/``--profile_start``/``--profile_steps``):
+  captures exactly the steady-state window, skipping compile.
+- ``start_server(port)`` — live-attach profiler server (``tensorboard
+  --logdir`` + capture button) for long multi-host runs.
+
+Traces are written per-host into ``<dir>/host_<k>`` so pod captures don't
+collide on shared filesystems.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+def _host_dir(log_dir: str) -> str:
+    path = os.path.join(log_dir, f"host_{jax.process_index()}")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace for the duration of the block."""
+    with jax.profiler.trace(_host_dir(log_dir)):
+        yield
+
+
+def start_server(port: int = 9999):
+    """Start the live profiler server (attach via TensorBoard capture)."""
+    return jax.profiler.start_server(port)
+
+
+class WindowedTrace:
+    """Trace exactly the steps in ``[start, start + num_steps)``.
+
+    Call ``step(i)`` at the top of every training step; the first traced step
+    is ``start`` (letting compile/warmup steps pass untraced), and the trace
+    stops after ``num_steps`` steps or at ``close()``.
+    """
+
+    def __init__(self, log_dir: Optional[str], start: int = 5, num_steps: int = 5):
+        self.log_dir = log_dir
+        self.start = start
+        self.stop = start + num_steps
+        self._active = False
+
+    def step(self, i: int) -> None:
+        if not self.log_dir:
+            return
+        if not self._active and i == self.start:
+            jax.profiler.start_trace(_host_dir(self.log_dir))
+            self._active = True
+        elif self._active and i >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
