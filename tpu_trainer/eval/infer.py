@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_trainer.models.config import GPTConfig
-from tpu_trainer.models.gpt import generate
+from tpu_trainer.models.gpt import generate, generate_kv
 from tpu_trainer.utils.checkpoint import latest_checkpoint, restore_params
 from tpu_trainer.utils.tokenizer import get_tokenizer
 
@@ -47,6 +47,10 @@ def main(argv=None) -> int:
     p.add_argument("--tokenizer", default="gpt2")
     p.add_argument("--device", default=None, choices=[None, "cpu", "tpu"],
                    help="cpu forces the host platform")
+    p.add_argument("--no_kv_cache", action="store_true",
+                   help="use the windowed full-forward sampler (the "
+                        "reference's O(S^2) semantics) instead of the "
+                        "KV-cached decoder")
     args = p.parse_args(argv)
 
     if args.device == "cpu":
@@ -83,7 +87,11 @@ def main(argv=None) -> int:
         )
     input_ids = jnp.asarray(ids, jnp.int32)[None, :]
 
-    out = generate(
+    # KV-cached decode (O(S) per token) when the result fits the cache;
+    # the windowed full-forward path handles overflow and --no_kv_cache.
+    fits = input_ids.shape[1] + args.max_new_tokens <= config.max_seq_len
+    sampler = generate_kv if (fits and not args.no_kv_cache) else generate
+    out = sampler(
         params,
         jax.random.PRNGKey(args.seed),
         input_ids,

@@ -98,12 +98,21 @@ def apply_rotary_pos_emb(
 
 
 class CausalSelfAttention(nn.Module):
-    """Multi-head causal self-attention (reference ``gpt.py:150-242``)."""
+    """Multi-head causal self-attention (reference ``gpt.py:150-242``).
+
+    ``decode=True`` switches to KV-cached autoregressive mode: new keys and
+    values land in a ``cache`` collection at the running position, and
+    queries attend over the cache — the fast decode path the reference lacks
+    (its generate re-runs the full O(S^2) forward per token, ``infer.py``
+    hot loop, SURVEY.md §3.5).
+    """
 
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, deterministic: bool = True, decode: bool = False
+    ) -> jax.Array:
         cfg = self.config
         b, s, _ = x.shape
         dense = functools.partial(
@@ -123,35 +132,88 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
         v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
 
-        cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
-        q, k = apply_rotary_pos_emb(q, k, cos, sin)
-
-        needs_rng = cfg.attention_dropout > 0.0 and not deterministic
-        dropout_rng = self.make_rng("dropout") if needs_rng else None
-        sp_ctx = ring.current_context()
-        if sp_ctx is not None and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1:
-            # Sequence parallelism: K/V ring over the mesh's sequence axis.
-            if needs_rng:
-                raise NotImplementedError(
-                    "attention dropout is not supported under ring attention; "
-                    "set attention_dropout=0 for sequence parallelism"
-                )
-            out = ring.ring_attention(q, k, v, sp_ctx.mesh, sp_ctx.axis_name)
+        if decode:
+            out = self._decode_attention(q, k, v)
         else:
-            attn_fn = (
-                flash_attention if cfg.use_flash_attention else reference_attention
-            )
-            out = attn_fn(
-                q, k, v,
-                dropout_rate=cfg.attention_dropout,
-                deterministic=deterministic,
-                dropout_rng=dropout_rng,
-            )
+            cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
+            q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+            needs_rng = cfg.attention_dropout > 0.0 and not deterministic
+            dropout_rng = self.make_rng("dropout") if needs_rng else None
+            sp_ctx = ring.current_context()
+            if sp_ctx is not None and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1:
+                # Sequence parallelism: K/V ring over the mesh's sequence axis.
+                if needs_rng:
+                    raise NotImplementedError(
+                        "attention dropout is not supported under ring "
+                        "attention; set attention_dropout=0 for sequence "
+                        "parallelism"
+                    )
+                out = ring.ring_attention(q, k, v, sp_ctx.mesh, sp_ctx.axis_name)
+            else:
+                attn_fn = (
+                    flash_attention if cfg.use_flash_attention
+                    else reference_attention
+                )
+                out = attn_fn(
+                    q, k, v,
+                    dropout_rate=cfg.attention_dropout,
+                    deterministic=deterministic,
+                    dropout_rng=dropout_rng,
+                )
 
         out = out.reshape(b, s, cfg.hidden_size)
         out = dense(name="o_proj")(out)
         out = nn.Dropout(rate=cfg.dropout)(out, deterministic=deterministic)
         return out
+
+    def _decode_attention(self, q, k, v) -> jax.Array:
+        """KV-cached attention over ``cache`` variables.
+
+        The cache holds ``[b, max_seq_len, heads, head_dim]`` per layer plus
+        the running length ``idx``; a call with ``s`` tokens appends at
+        ``idx`` (prefill: s = prompt length; decode: s = 1) and every query
+        attends to positions ``<= its own``. RoPE is applied at the *global*
+        positions ``idx..idx+s-1``.
+        """
+        cfg = self.config
+        b, s, h, d = q.shape
+        max_len = cfg.max_seq_len
+        ck = self.variable(
+            "cache", "k", jnp.zeros, (b, max_len, h, d), cfg.compute_dtype
+        )
+        cv = self.variable(
+            "cache", "v", jnp.zeros, (b, max_len, h, d), cfg.compute_dtype
+        )
+        ci = self.variable(
+            "cache", "idx", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = ci.value
+
+        cos, sin = rope_tables(max_len, d, cfg.rope_theta)
+        cos_s = jax.lax.dynamic_slice(cos, (idx, 0), (s, d))
+        sin_s = jax.lax.dynamic_slice(sin, (idx, 0), (s, d))
+        q, k = apply_rotary_pos_emb(q, k, cos_s, sin_s)
+
+        k_all = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        if not self.is_initializing():
+            ck.value = k_all
+            cv.value = v_all
+            ci.value = idx + s
+
+        scale = 1.0 / (d ** 0.5)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * scale
+        q_pos = idx + jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
+        allowed = k_pos <= q_pos
+        scores = jnp.where(
+            allowed[None, None], scores, jnp.finfo(scores.dtype).min
+        )
+        weights = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
 
 
 class MLP(nn.Module):
@@ -187,13 +249,16 @@ class TransformerBlock(nn.Module):
 
     config: GPTConfig
     deterministic: bool = True
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, _unused=None):
         cfg = self.config
         residual = x
         h = RMSNorm(dtype=cfg.compute_dtype, name="input_layernorm")(x)
-        h = CausalSelfAttention(cfg, name="attention")(h, self.deterministic)
+        h = CausalSelfAttention(cfg, name="attention")(
+            h, self.deterministic, self.decode
+        )
         x = residual + h
 
         residual = x
@@ -215,6 +280,7 @@ class GPT(nn.Module):
         attention_mask: Optional[jax.Array] = None,
         labels: Optional[jax.Array] = None,
         train: bool = False,
+        decode: bool = False,
     ) -> Tuple[jax.Array, Optional[jax.Array]]:
         """Forward pass.
 
@@ -236,17 +302,19 @@ class GPT(nn.Module):
         x = embed(input_ids)
 
         block = TransformerBlock
-        if cfg.gradient_checkpointing:
+        if cfg.gradient_checkpointing and not decode:
             # Remat per block — the reference's activation-checkpointing unit
             # (gpt.py:440-444, fsdp_trainer.py:312-328).
             block = nn.remat(block, prevent_cse=False)
         layers = nn.scan(
             block,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.num_layers,
         )
-        x, _ = layers(cfg, deterministic=not train, name="layers")(x, None)
+        x, _ = layers(
+            cfg, deterministic=not train, decode=decode, name="layers"
+        )(x, None)
 
         x = RMSNorm(dtype=cfg.compute_dtype, name="norm")(x)
         # Weight tying (reference gpt.py:342): logits via the embedding matrix.
@@ -318,16 +386,106 @@ def generate(
         logits, _ = model.apply({"params": params}, ids)
         pos = i - 1 - start  # index of the newest real token inside the window
         last = jax.lax.dynamic_slice(logits, (0, pos, 0), (b, 1, logits.shape[-1]))[:, 0]
-        last = last / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(last, min(top_k, last.shape[-1]))[0][:, -1:]
-            last = jnp.where(last < kth, -jnp.inf, last)
         rng, sub = jax.random.split(rng)
-        nxt = jax.random.categorical(sub, last).astype(buf.dtype)
+        nxt = _sample(last, sub, temperature, top_k).astype(buf.dtype)
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
         return buf, rng
 
     buf, _ = jax.lax.fori_loop(prompt_len, total, body, (buf, rng))
+    return buf
+
+
+def init_cache(config: GPTConfig, batch_size: int):
+    """Zero-initialized KV cache pytree for ``generate_kv``."""
+    model = GPT(config)
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((batch_size, 1), jnp.int32),
+            decode=True,
+        )["cache"]
+    )
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """Temperature + top-k categorical sampling (reference gpt.py:473-482)."""
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "max_new_tokens", "temperature", "top_k")
+)
+def generate_kv(
+    params,
+    rng: jax.Array,
+    input_ids: jax.Array,
+    *,
+    config: GPTConfig,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    top_k: int = 50,
+) -> jax.Array:
+    """KV-cached autoregressive sampling: one prefill pass over the prompt,
+    then one single-token forward per generated token.
+
+    Same sampling semantics as ``generate`` (temperature, top-k,
+    ``max_seq_len`` context limit) but O(S) per token instead of the
+    reference's O(S^2) full re-forward (``infer.py`` hot loop, SURVEY.md
+    §3.5). Requires ``prompt_len + max_new_tokens <= config.max_seq_len``
+    (the cache size); ``generate`` handles the windowed overflow case.
+    """
+    model = GPT(config)
+    b, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the cache size (max_seq_len={config.max_seq_len}); "
+            f"use generate() for windowed generation"
+        )
+    if max_new_tokens == 0:
+        return input_ids
+    cache = init_cache(config, b)
+
+    # Prefill: one pass over the whole prompt populates every layer's cache.
+    (logits, _), vars_out = model.apply(
+        {"params": params, "cache": cache},
+        input_ids,
+        decode=True,
+        mutable=["cache"],
+    )
+    cache = vars_out["cache"]
+    rng, sub = jax.random.split(rng)
+    nxt = _sample(logits[:, -1], sub, temperature, top_k).astype(input_ids.dtype)
+
+    buf = jnp.zeros((b, total), input_ids.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, input_ids, (0, 0))
+    buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, prompt_len))
+
+    def body(i, carry):
+        buf, cache, rng = carry
+        tok = jax.lax.dynamic_slice(buf, (0, i - 1), (b, 1))
+        (logits, _), vars_out = model.apply(
+            {"params": params, "cache": cache},
+            tok,
+            decode=True,
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], sub, temperature, top_k).astype(buf.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
+        return buf, vars_out["cache"], rng
+
+    buf, _, _ = jax.lax.fori_loop(
+        prompt_len + 1, total, body, (buf, cache, rng)
+    )
     return buf
 
 

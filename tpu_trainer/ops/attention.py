@@ -67,26 +67,30 @@ def flash_attention(
 ) -> jax.Array:
     """Fused causal attention (reference flash path, ``gpt.py:199-206``).
 
-    Dispatches to the Pallas TPU kernel when running on TPU; otherwise uses
-    XLA's fused dot-product attention. When attention dropout is active
-    (training), falls back to the manual path so dropout semantics match the
-    reference exactly.
+    Dispatches to the Pallas TPU kernel when running on TPU — including
+    training with attention-weight dropout, which the kernel implements with
+    a counter-based in-kernel mask (``ops/flash.py``; no [seq, seq] buffer).
+    Off-TPU, uses XLA's fused attention, with the manual path covering the
+    dropout case (same semantics as the reference's manual branch).
     """
-    if dropout_rate > 0.0 and not deterministic:
-        # Fused kernels don't implement attention-weight dropout yet; match the
-        # reference's training semantics via the manual path.
+    active_dropout = dropout_rate > 0.0 and not deterministic
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    if on_tpu:
+        try:
+            from tpu_trainer.ops import flash
+        except ImportError:
+            flash = None  # degrade to the XLA/manual paths below
+        if flash is not None:
+            return flash.flash_attention(
+                q, k, v, causal=True,
+                dropout_rate=dropout_rate if active_dropout else 0.0,
+                dropout_rng=dropout_rng,
+            )
+    if active_dropout:
         return reference_attention(
             q, k, v,
             dropout_rate=dropout_rate,
             deterministic=deterministic,
             dropout_rng=dropout_rng,
         )
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if on_tpu:
-        try:
-            from tpu_trainer.ops import flash  # local import: pallas only on TPU
-
-            return flash.flash_attention(q, k, v, causal=True)
-        except ImportError:
-            pass
     return jax.nn.dot_product_attention(q, k, v, is_causal=True)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,44 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = float("-inf")
+_GOLDEN = 0x9E3779B9  # Weyl increment for the per-(batch,head) salt
+
+
+def _keep_mask(seed_u32, salt_u32, q_start, k_start, bq: int, bk: int,
+               seq: int, rate: float):
+    """Deterministic counter-based dropout mask for one score block.
+
+    A murmur3-finalizer hash of the *global* (q, k) position plus a
+    per-(batch, head) salt — recomputable bit-for-bit in the backward
+    kernels (the flash-attention equivalent of storing the mask, at zero
+    memory). Pure jnp bitwise ops, so it runs identically compiled on TPU
+    and interpreted on CPU (``pltpu.prng_*`` has no interpret lowering).
+    Positions must fit uint32: seq < 2**16.
+    """
+    rows = (q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ).astype(jnp.uint32)
+    cols = (k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            ).astype(jnp.uint32)
+    x = rows * jnp.uint32(seq) + cols
+    x = x ^ (seed_u32 + salt_u32 * jnp.uint32(_GOLDEN))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
+    return x >= threshold  # keep with probability 1 - rate
+
+
+def _block_salt():
+    """Per-(batch, head) hash salt from the grid position."""
+    return (pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+            ).astype(jnp.uint32)
+
+
+def _seed_from_ref(seed_ref):
+    """uint32 seed scalar from the (1,1) SMEM input."""
+    return seed_ref[0, 0]
 
 
 # --------------------------------------------------------------------------
@@ -47,7 +86,8 @@ _NEG_INF = float("-inf")
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                *, block_k, scale, causal, dropout_rate):
     # q_ref: [1, 1, block_q, d]; k_ref/v_ref: [1, 1, seq, d];
     # lse_ref: [1, 1, 1, seq] (full row, written blockwise).
     block_q = q_ref.shape[2]
@@ -55,6 +95,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
     seq = k_ref.shape[2]
     iq = pl.program_id(2)
     q_start = iq * block_q
+    seed = _seed_from_ref(seed_ref)
+    salt = _block_salt()
 
     q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # [bq, d]
 
@@ -76,7 +118,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
+        # The softmax normalizer sums the *undropped* weights (dropout acts
+        # on normalized weights in the reference, gpt.py:230-234 semantics).
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed, salt, q_start, ik * block_k,
+                              block_q, block_k, seq, dropout_rate)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc_new = acc * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
@@ -93,8 +141,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, scale, causal):
     lse_ref[0, 0, 0, pl.ds(q_start, block_q)] = m[:, 0] + jnp.log(l[:, 0])
 
 
-def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
-    # q, k, v: BHSD [b, h, s, d]
+def _seed_spec():
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_forward(q, k, v, seed_f, *, causal, block_q, block_k, interpret,
+                   dropout_rate):
+    # q, k, v: BHSD [b, h, s, d]; seed_f: (1,1) float32 bit-carrier (floats
+    # so custom_vjp has a well-defined cotangent; re-bitcast to uint32 here,
+    # outside the kernel — Mosaic can't bitcast scalars in-kernel).
+    seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     grid = (b, h, s // block_q)
@@ -103,17 +161,18 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
     row_spec = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, iq: (ib, ih, 0, 0))
     o, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, block_k=block_k, scale=scale, causal=causal
+            _fwd_kernel, block_k=block_k, scale=scale, causal=causal,
+            dropout_rate=dropout_rate,
         ),
         grid=grid,
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=[_seed_spec(), q_spec, kv_spec, kv_spec],
         out_specs=[q_spec, row_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(seed_f, q, k, v)
     return o, lse
 
 
@@ -123,13 +182,16 @@ def _flash_forward(q, k, v, *, causal, block_q, block_k, interpret):
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, scale, causal
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, block_k, scale, causal, dropout_rate
 ):
     block_q = q_ref.shape[2]
     d = q_ref.shape[3]
     seq = k_ref.shape[2]
     iq = pl.program_id(2)
     q_start = iq * block_q
+    seed = _seed_from_ref(seed_ref)
+    salt = _block_salt()
 
     q = q_ref[0, 0, :, :].astype(jnp.float32)
     do = do_ref[0, 0, :, :].astype(jnp.float32)
@@ -149,10 +211,16 @@ def _dq_kernel(
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, _NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+        p = jnp.exp(s - lse)                       # [bq, bk] (normalized)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if dropout_rate > 0.0:
+            # d/ds with dropout m: ds = p * (m/(1-r) * (do.v) - delta);
+            # the mask regenerates bit-identically from the same counters.
+            keep = _keep_mask(seed, salt, q_start, ik * block_k,
+                              block_q, block_k, seq, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta)
         return dq + jnp.dot(ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
@@ -165,14 +233,16 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q, scale, causal,
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, scale, causal, dropout_rate,
 ):
     block_k = k_ref.shape[2]
     d = k_ref.shape[3]
     seq = q_ref.shape[2]
     ik = pl.program_id(2)
     k_start = ik * block_k
+    seed = _seed_from_ref(seed_ref)
+    salt = _block_salt()
 
     k = k_ref[0, 0, :, :].astype(jnp.float32)
     v = v_ref[0, 0, :, :].astype(jnp.float32)
@@ -194,12 +264,19 @@ def _dkv_kernel(
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, _NEG_INF)
         p = jnp.exp(s - lse)                       # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed, salt, iq * block_q, k_start,
+                              block_q, block_k, seq, dropout_rate)
+            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        else:
+            p_drop = p
+        dv_new = dv + jax.lax.dot_general(
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)                      # [bq, bk]
         dk_new = dk + jax.lax.dot_general(
@@ -218,7 +295,8 @@ def _dkv_kernel(
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, o, lse, do, seed_f, *, causal, block_q, block_k,
+                    interpret, dropout_rate):
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term.
@@ -226,30 +304,33 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret)
         "bhsd,bhsd->bhs", do.astype(jnp.float32), o.astype(jnp.float32)
     )[:, :, None, :]
 
+    seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     blk = lambda n: pl.BlockSpec((1, 1, n, d), lambda ib, ih, i: (ib, ih, i, 0))
     full = pl.BlockSpec((1, 1, s, d), lambda ib, ih, i: (ib, ih, 0, 0))
     row = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, i: (ib, ih, 0, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
+                          causal=causal, dropout_rate=dropout_rate),
         grid=(b, h, s // block_q),
-        in_specs=[blk(block_q), full, full, blk(block_q), row, row],
+        in_specs=[_seed_spec(), blk(block_q), full, full, blk(block_q), row, row],
         out_specs=blk(block_q),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(seed_f, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
+                          causal=causal, dropout_rate=dropout_rate),
         grid=(b, h, s // block_k),
-        in_specs=[full, blk(block_k), blk(block_k), full, row, row],
+        in_specs=[_seed_spec(), full, blk(block_k), blk(block_k), full, row, row],
         out_specs=[blk(block_k), blk(block_k)],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(seed_f, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -259,28 +340,24 @@ def _flash_backward(q, k, v, o, lse, do, *, causal, block_q, block_k, interpret)
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool):
+def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
+                dropout_rate: float):
+    kw = dict(causal=causal, block_q=block_q, block_k=block_k,
+              interpret=interpret, dropout_rate=dropout_rate)
+
     @jax.custom_vjp
-    def flash(q, k, v):
-        o, _ = _flash_forward(
-            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
-        )
+    def flash(q, k, v, seed_f):
+        o, _ = _flash_forward(q, k, v, seed_f, **kw)
         return o
 
-    def fwd(q, k, v):
-        o, lse = _flash_forward(
-            q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-            interpret=interpret,
-        )
-        return o, (q, k, v, o, lse)
+    def fwd(q, k, v, seed_f):
+        o, lse = _flash_forward(q, k, v, seed_f, **kw)
+        return o, (q, k, v, o, lse, seed_f)
 
     def bwd(res, do):
-        q, k, v, o, lse = res
-        return _flash_backward(
-            q, k, v, o, lse, do,
-            causal=causal, block_q=block_q, block_k=block_k, interpret=interpret,
-        )
+        q, k, v, o, lse, seed_f = res
+        dq, dk, dv = _flash_backward(q, k, v, o, lse, do, seed_f, **kw)
+        return dq, dk, dv, jnp.zeros_like(seed_f)
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -295,21 +372,48 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blockwise causal flash attention; BSHD in, BSHD out.
 
-    Falls back to XLA's fused attention when the sequence length doesn't tile
-    (the kernel requires ``seq % block == 0``) — e.g. odd-length generate
-    windows.
+    ``dropout_rate > 0`` (with a PRNG key) applies attention-weight dropout
+    *inside* the kernel via a counter-based mask — no [seq, seq] mask array
+    ever exists, and training with the reference's default attention dropout
+    keeps the flash memory profile. Falls back to XLA's fused attention when
+    the sequence length doesn't tile (the kernel requires
+    ``seq % block == 0``) — e.g. odd-length generate windows (dropout is
+    inference-off there by construction).
     """
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q != 0 or s % block_k != 0 or s < 8:
+        if dropout_rate > 0.0:
+            # The XLA fused path has no attention dropout; keep the
+            # configured semantics via the jnp reference path.
+            from tpu_trainer.ops.attention import reference_attention
+
+            return reference_attention(
+                q, k, v, dropout_rate=dropout_rate, deterministic=False,
+                dropout_rng=dropout_rng,
+            )
         return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
-    fn = _make_flash(causal, block_q, block_k, interpret)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        if s >= 2**16:
+            raise NotImplementedError(
+                "kernel dropout counters are uint32: seq must be < 65536"
+            )
+        seed_bits = jax.random.bits(dropout_rng, dtype=jnp.uint32)
+    else:
+        seed_bits = jnp.uint32(0)
+    seed_f = jax.lax.bitcast_convert_type(seed_bits, jnp.float32).reshape(1, 1)
+    fn = _make_flash(causal, block_q, block_k, interpret, float(dropout_rate))
     # BSHD -> BHSD for the kernel's (seq, head_dim) innermost tiling.
     out = fn(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), seed_f,
     )
     return out.transpose(0, 2, 1, 3)
