@@ -31,6 +31,7 @@ def _rand_qkv(key, b, s, h, d, dtype=jnp.float32):
         (2, 256, 4, 64, 128),   # multi-block causal
         (1, 128, 2, 32, 64),    # two kv blocks per q block
         (2, 128, 3, 64, 128),   # single block (diagonal only)
+        (1, 768, 2, 32, 512),   # 512 doesn't divide 768 -> auto-drop to 256
     ],
 )
 def test_forward_matches_reference(b, s, h, d, block):

@@ -193,9 +193,12 @@ class TestGPTForward:
         l2, loss2 = model_remat.apply({"params": params}, ids, labels=ids)
         np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-5)
 
-    def test_remat_same_gradients(self):
+    @pytest.mark.parametrize("policy", ["full", "dots"])
+    def test_remat_same_gradients(self, policy):
         config = tiny_config()
-        config_remat = tiny_config(gradient_checkpointing=True)
+        config_remat = tiny_config(
+            gradient_checkpointing=True, remat_policy=policy
+        )
         model, params, ids = init_model(config)
         model_remat = GPT(config_remat)
 

@@ -67,6 +67,14 @@ class GPTConfig:
     # Optimization flags (reference config.py:30-32)
     use_flash_attention: bool = False
     gradient_checkpointing: bool = False
+    # Rematerialization policy when gradient_checkpointing is on:
+    # "full"  — save only block inputs, recompute everything (the reference's
+    #           activation-checkpointing semantics; max memory savings);
+    # "dots"  — save matmul outputs, recompute elementwise chains (dropout
+    #           masks, norms, activations). Cheaper in compute than "full"
+    #           and cuts the per-layer activation stores that dominate HBM
+    #           write traffic in the unremated step.
+    remat_policy: str = "full"
 
     # TPU dtype policy: compute dtype for activations/matmuls; params and the
     # softmax/loss accumulations stay float32.
@@ -80,6 +88,11 @@ class GPTConfig:
             f"hidden_size ({self.hidden_size}) must be divisible by "
             f"num_heads ({self.num_heads})"
         )
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                f"choose from ['dots', 'full']"
+            )
 
     @property
     def head_dim(self) -> int:

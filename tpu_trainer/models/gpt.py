@@ -304,8 +304,15 @@ class GPT(nn.Module):
         block = TransformerBlock
         if cfg.gradient_checkpointing and not decode:
             # Remat per block — the reference's activation-checkpointing unit
-            # (gpt.py:440-444, fsdp_trainer.py:312-328).
-            block = nn.remat(block, prevent_cse=False)
+            # (gpt.py:440-444, fsdp_trainer.py:312-328). Policy selects what
+            # survives to the backward pass (config.remat_policy).
+            policies = {
+                "full": None,
+                "dots": jax.checkpoint_policies.dots_saveable,
+            }
+            block = nn.remat(
+                block, prevent_cse=False, policy=policies[cfg.remat_policy]
+            )
         layers = nn.scan(
             block,
             variable_axes={"params": 0, "cache": 0},
