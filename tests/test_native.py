@@ -1,0 +1,121 @@
+"""Native host-kernel tests (tpu_trainer/native).
+
+The C fast path must be semantically identical to the pure-Python loop in
+``data/text.py`` — the Python path is the reference implementation. Skips
+cleanly when no C compiler is available (the loaders then use Python).
+"""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from tpu_trainer import native
+from tpu_trainer.data.text import TextDataset
+from tpu_trainer.utils.tokenizer import ByteTokenizer
+
+pytestmark = pytest.mark.skipif(
+    native.get_lib() is None, reason="no C toolchain for the native library"
+)
+
+LINES = [
+    "hello world",
+    "",                       # empty: skipped
+    "   padded line \t ",     # stripped
+    "third line with text",
+    "\t\t",                   # whitespace-only: skipped
+    "final",
+]
+TEXT = "\n".join(LINES) + "\n"
+
+
+def _python_reference(text, eos, shard_id=0, num_shards=1, max_tokens=None):
+    tok = ByteTokenizer()
+    ids = []
+    for i, line in enumerate(text.splitlines()):
+        if i % num_shards != shard_id:
+            continue
+        line = line.strip()
+        if not line:
+            continue
+        ids.extend(tok.encode(line))
+        ids.append(eos)
+        if max_tokens is not None and len(ids) >= max_tokens:
+            return ids[:max_tokens]
+    return ids
+
+
+class TestByteTokenize:
+    def test_matches_python_reference(self):
+        got = native.byte_tokenize(TEXT.encode(), eos_id=50256)
+        want = _python_reference(TEXT, 50256)
+        np.testing.assert_array_equal(got, np.asarray(want, np.int32))
+
+    def test_sharding_matches(self):
+        for shard in range(3):
+            got = native.byte_tokenize(
+                TEXT.encode(), 50256, shard_id=shard, num_shards=3
+            )
+            want = _python_reference(TEXT, 50256, shard, 3)
+            np.testing.assert_array_equal(got, np.asarray(want, np.int32))
+
+    def test_max_tokens_budget(self):
+        got = native.byte_tokenize(TEXT.encode(), 50256, max_tokens=7)
+        want = _python_reference(TEXT, 50256, max_tokens=7)
+        assert got.size == 7
+        np.testing.assert_array_equal(got, np.asarray(want, np.int32))
+
+    def test_no_trailing_newline(self):
+        text = "abc\ndef"  # last line unterminated
+        got = native.byte_tokenize(text.encode(), 9)
+        want = _python_reference(text, 9)
+        np.testing.assert_array_equal(got, np.asarray(want, np.int32))
+
+    def test_large_buffer_roundtrip(self):
+        text = "\n".join(f"line {i} " + "x" * (i % 57) for i in range(5000))
+        got = native.byte_tokenize(text.encode(), 50256)
+        want = _python_reference(text, 50256)
+        np.testing.assert_array_equal(got, np.asarray(want, np.int32))
+
+
+class TestDatasetIntegration:
+    def test_dataset_chunks_identical_with_and_without_native(
+        self, tmp_path, monkeypatch
+    ):
+        p = tmp_path / "corpus.txt"
+        p.write_text("\n".join(f"story {i} " + "w " * 40 for i in range(50)))
+        ds_native = TextDataset(str(p), seq_len=64)
+        monkeypatch.setattr(native, "byte_tokenize",
+                            lambda *a, **k: None)  # force Python path
+        ds_python = TextDataset(str(p), seq_len=64)
+        np.testing.assert_array_equal(ds_native.chunks, ds_python.chunks)
+
+    def test_gzip_path_uses_native(self, tmp_path, monkeypatch):
+        p = tmp_path / "corpus.txt.gz"
+        with gzip.open(p, "wt") as f:
+            f.write("\n".join(f"story {i} " + "w " * 40 for i in range(20)))
+        calls = []
+        orig = native.byte_tokenize
+
+        def spy(*a, **k):
+            out = orig(*a, **k)
+            calls.append(out)
+            return out
+
+        monkeypatch.setattr(native, "byte_tokenize", spy)
+        ds = TextDataset(str(p), seq_len=32)
+        assert len(ds) > 0
+        assert calls and calls[0] is not None  # native path actually taken
+
+    def test_non_ascii_falls_back_to_python(self, tmp_path):
+        # Unicode whitespace / non-ASCII must not silently diverge: the C
+        # path refuses and the Python path (authoritative) is used.
+        text = "café au lait\nplain ascii line\n"
+        assert native.byte_tokenize(text.encode(), 50256) is None
+        p = tmp_path / "uni.txt"
+        p.write_text(text * 40)
+        ds = TextDataset(str(p), seq_len=16)  # works via the Python path
+        assert len(ds) > 0
+
+    def test_carriage_return_falls_back(self):
+        assert native.byte_tokenize(b"a\rb\nplain\n", 9) is None

@@ -1,0 +1,71 @@
+"""Host-memory optimizer-state offload (reference ``FSDPConfig.cpu_offload``,
+``fsdp_trainer.py:62-63,299-301`` — SURVEY.md C10).
+
+The TPU design keeps optimizer state in ``pinned_host`` memory and streams
+it through the device inside the jitted step. Numerics must be identical to
+the on-device step; only placement changes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel.mesh import MeshConfig
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+TINY = GPTConfig(
+    vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+    max_seq_len=32, dropout=0.0, attention_dropout=0.0,
+    use_flash_attention=False, dtype="float32",
+)
+TRAIN = TrainingConfig(
+    batch_size=1, max_seq_len=32, gradient_accumulation_steps=1,
+    mixed_precision="fp32", warmup_steps=2, max_steps=10,
+)
+
+
+def _backend_supports_pinned_host() -> bool:
+    try:
+        from jax.sharding import SingleDeviceSharding
+
+        s = SingleDeviceSharding(jax.devices()[0], memory_kind="pinned_host")
+        jax.jit(lambda x: x + 1, out_shardings=s)(jnp.ones(8))
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _backend_supports_pinned_host(),
+    reason="backend has no pinned_host memory space",
+)
+
+
+def test_offload_matches_on_device_losses():
+    batch = np.random.default_rng(0).integers(0, 128, (8, 32), np.int32)
+    losses = {}
+    for offload in (False, True):
+        trainer = Trainer(
+            TINY, TRAIN,
+            ParallelConfig(
+                MeshConfig(data=1, fsdp=-1), "zero3", cpu_offload=offload
+            ),
+        )
+        state = trainer.init_state(seed=0)
+        if offload:
+            kinds = {
+                s.memory_kind
+                for s in jax.tree_util.tree_leaves(
+                    jax.tree_util.tree_map(
+                        lambda x: x.sharding, state.opt_state
+                    )
+                )
+            }
+            assert kinds == {"pinned_host"}
+        for _ in range(3):
+            state, metrics = trainer.train_step(state, batch)
+        losses[offload] = float(metrics["loss"])
+    assert losses[False] == pytest.approx(losses[True], rel=1e-6)

@@ -42,7 +42,7 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
-from tpu_trainer.utils.tokenizer import get_tokenizer
+from tpu_trainer.utils.tokenizer import ByteTokenizer, get_tokenizer
 
 
 class LRUTokenCache:
@@ -95,6 +95,16 @@ def open_text(path: str):
     return open(path, "r", encoding="utf-8", errors="replace")
 
 
+def read_bytes(path: str, limit: Optional[int] = None) -> bytes:
+    """Raw bytes with gzip transparency (native fast path). ``limit`` caps
+    the read so a token budget doesn't force loading a huge corpus."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rb") as f:
+            return f.read() if limit is None else f.read(limit)
+    with open(path, "rb") as f:
+        return f.read() if limit is None else f.read(limit)
+
+
 class TextDataset:
     """Map-style: tokenize the whole file, chunk to ``seq_len``
     (reference ``tinystories.py:22-50``).
@@ -112,25 +122,49 @@ class TextDataset:
         self.path = resolve_path(path)
         self.seq_len = seq_len
         tokenizer = get_tokenizer(tokenizer_name)
-        ids: List[int] = []
-        with open_text(self.path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                ids.extend(tokenizer.encode(line))
-                ids.append(tokenizer.eos_token_id)
-                if max_tokens is not None and len(ids) >= max_tokens:
-                    ids = ids[:max_tokens]
-                    break
-        n_chunks = len(ids) // seq_len
+
+        arr: Optional[np.ndarray] = None
+        if isinstance(tokenizer, ByteTokenizer):
+            # Native one-pass strip/tokenize (tpu_trainer/native); falls
+            # through to the Python loop when the library is unavailable or
+            # the bytes need Python text semantics. With a token budget,
+            # read only a bounded prefix (>= 1 byte/token plus slack); if
+            # that prefix can't fill the budget the Python path decides.
+            from tpu_trainer import native
+
+            limit = None if max_tokens is None else 4 * max_tokens + 65536
+            data = read_bytes(self.path, limit)
+            arr = native.byte_tokenize(
+                data, tokenizer.eos_token_id, max_tokens=max_tokens,
+            )
+            if (
+                arr is not None
+                and max_tokens is not None
+                and arr.size < max_tokens
+                and limit is not None
+                and len(data) == limit  # possibly truncated read
+            ):
+                arr = None
+        if arr is None:
+            ids: List[int] = []
+            with open_text(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ids.extend(tokenizer.encode(line))
+                    ids.append(tokenizer.eos_token_id)
+                    if max_tokens is not None and len(ids) >= max_tokens:
+                        ids = ids[:max_tokens]
+                        break
+            arr = np.asarray(ids, dtype=np.int32)
+
+        n_chunks = arr.size // seq_len
         if n_chunks == 0:
             raise ValueError(
-                f"{path}: only {len(ids)} tokens, need >= seq_len ({seq_len})"
+                f"{path}: only {arr.size} tokens, need >= seq_len ({seq_len})"
             )
-        self.chunks = np.asarray(
-            ids[: n_chunks * seq_len], dtype=np.int32
-        ).reshape(n_chunks, seq_len)
+        self.chunks = arr[: n_chunks * seq_len].reshape(n_chunks, seq_len)
 
     def __len__(self) -> int:
         return self.chunks.shape[0]

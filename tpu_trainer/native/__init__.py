@@ -1,0 +1,121 @@
+"""Native (C) host-side kernels, built on demand and loaded via ctypes.
+
+The compute path of this framework is JAX/XLA/Pallas; these native pieces
+cover the *host* side, where the reference is pure Python (SURVEY.md §0:
+the reference has no native code at all — this is capability beyond it).
+Currently: the byte-tokenize + shard pipeline (``fast_text.c``), used by the
+data loaders when the byte-level tokenizer is active.
+
+Build strategy: compile ``fast_text.c`` with the system C compiler the
+first time it's needed (no pybind11/setuptools requirement; plain
+``cc -O3 -shared -fPIC``), cache the ``.so`` next to the source, and fall
+back to the pure-Python implementation if anything fails — the Python path
+stays the semantic reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fast_text.c")
+_LIB = os.path.join(_DIR, "libfast_text.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    # Compile to a process-unique temp path and rename into place: atomic on
+    # POSIX, so concurrent processes (pytest workers, pod hosts on a shared
+    # checkout) never dlopen a half-written library.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, _LIB)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            continue
+    return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled library, building it if necessary; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                warnings.warn(
+                    "could not build native fast_text library; using the "
+                    "pure-Python tokenizer path", stacklevel=2,
+                )
+                return None
+        lib = ctypes.CDLL(_LIB)
+        lib.fast_byte_tokenize.restype = ctypes.c_long
+        lib.fast_byte_tokenize.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int32,
+            ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.fast_count_lines.restype = ctypes.c_long
+        lib.fast_count_lines.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        _lib = lib
+    except OSError as e:
+        warnings.warn(f"native fast_text unavailable ({e}); using Python",
+                      stacklevel=2)
+    return _lib
+
+
+def byte_tokenize(
+    data: bytes,
+    eos_id: int,
+    shard_id: int = 0,
+    num_shards: int = 1,
+    max_tokens: Optional[int] = None,
+) -> Optional[np.ndarray]:
+    """One-pass strip/tokenize/shard of a text buffer -> int32 id array.
+
+    Semantics identical to the Python loop in ``data/text.py`` with the
+    ByteTokenizer: per kept line, stripped UTF-8 bytes then ``eos_id``.
+    Returns None when the native library is unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(data)
+    n_lines = lib.fast_count_lines(data, n)
+    bound = n + n_lines + 1
+    if max_tokens is not None:
+        bound = min(bound, int(max_tokens))
+    out = np.empty(max(bound, 1), dtype=np.int32)
+    budget = -1 if max_tokens is None else int(max_tokens)
+    written = lib.fast_byte_tokenize(
+        data, n, eos_id, shard_id, num_shards, budget,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if written < 0:
+        # Buffer contains bytes with Python-divergent semantics (non-ASCII,
+        # \r, exotic whitespace); the caller's Python path is authoritative.
+        return None
+    # Copy so the (worst-case-sized) work buffer is freed immediately.
+    return out[:written].copy()

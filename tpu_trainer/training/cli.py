@@ -31,7 +31,6 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import signal
-import warnings
 from typing import Optional
 
 import numpy as np
@@ -249,14 +248,14 @@ def resolve_configs(args, mode: str):
     )
 
     # --- parallelism ---------------------------------------------------
+    cpu_offload = False
     if mode == "fsdp":
         strategy = _pick(getattr(args, "sharding", None),
                          y_fsdp.get("sharding_strategy"), "FULL_SHARD")
-        if getattr(args, "cpu_offload", None) or y_fsdp.get("cpu_offload"):
-            warnings.warn(
-                "cpu_offload: host-memory offload of optimizer state is not "
-                "implemented yet; running fully on-device", stacklevel=2,
-            )
+        cpu_offload = bool(
+            _pick(getattr(args, "cpu_offload", None),
+                  y_fsdp.get("cpu_offload"), False)
+        )
         default_mesh = mesh_lib.MeshConfig(data=1, fsdp=-1)
     else:
         strategy = "replicated"
@@ -273,7 +272,9 @@ def resolve_configs(args, mode: str):
         sequence=_pick(args.mesh_sequence, default_mesh.sequence),
         tensor=_pick(args.mesh_tensor, default_mesh.tensor),
     )
-    parallel_config = ParallelConfig(mesh=mesh_config, sharding_strategy=strategy)
+    parallel_config = ParallelConfig(
+        mesh=mesh_config, sharding_strategy=strategy, cpu_offload=cpu_offload
+    )
 
     data_opts = {
         "dataset": _pick(args.dataset, y_data.get("dataset"), "dummy"),

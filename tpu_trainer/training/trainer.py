@@ -74,10 +74,16 @@ class ParallelConfig:
       ``zero3`` (FULL_SHARD) / ``zero2`` (SHARD_GRAD_OP) /
       ``replicated`` (NO_SHARD); reference spellings accepted.
     - HYBRID_SHARD: both axes > 1.
+    - ``cpu_offload`` (reference ``FSDPConfig.cpu_offload``,
+      ``fsdp_trainer.py:62-63,299-301``): optimizer state lives in host
+      memory (``pinned_host``) and is streamed to the device inside the
+      jitted step only for the update — the TPU analogue of torch FSDP's
+      ``CPUOffload``, trading step time for 2x param-bytes of HBM.
     """
 
     mesh: mesh_lib.MeshConfig = mesh_lib.MeshConfig()
     sharding_strategy: str = "replicated"
+    cpu_offload: bool = False
 
 
 class Trainer:
@@ -158,6 +164,44 @@ class Trainer:
             self.mesh,
         )
         self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
+
+        self.cpu_offload = parallel_config.cpu_offload
+        if self.cpu_offload:
+            kinds = {
+                m.kind for d in self.mesh.devices.flat
+                for m in d.addressable_memories()
+            }
+            platform = next(iter(self.mesh.devices.flat)).platform
+            multi = self.mesh.size > 1
+            if "pinned_host" not in kinds or (platform == "cpu" and multi):
+                import warnings
+
+                warnings.warn(
+                    "cpu_offload requested but this backend cannot host-"
+                    "offload here (no pinned_host memory space, or the CPU "
+                    "SPMD partitioner's UNIMPLEMENTED multi-device "
+                    "placement); keeping optimizer state on device",
+                    stacklevel=2,
+                )
+                self.cpu_offload = False
+        if self.cpu_offload:
+            # Optimizer state is host-resident; the step streams it through
+            # the device around the update (jax.device_put inside jit).
+            # Scalar leaves (Adam's step count) stay on device — the SPMD
+            # partitioner rejects placement annotations on scalars, and
+            # they're bytes anyway.
+            self._opt_device_shardings = self.state_shardings.opt_state
+            self._opt_host_shardings = jax.tree_util.tree_map(
+                lambda ns, shape: (
+                    NamedSharding(self.mesh, ns.spec, memory_kind="pinned_host")
+                    if getattr(shape, "ndim", 0) >= 1 else ns
+                ),
+                self.state_shardings.opt_state,
+                state_shapes.opt_state,
+            )
+            self.state_shardings = self.state_shardings.replace(
+                opt_state=self._opt_host_shardings
+            )
 
         self._init_jit = jax.jit(self._make_state, out_shardings=self.state_shardings)
         self._step_jit = jax.jit(
@@ -342,9 +386,14 @@ class Trainer:
         lr = cfg.lr_at(state.step)
 
         def apply_update(_):
+            opt_in = state.opt_state
+            if self.cpu_offload:
+                opt_in = jax.device_put(opt_in, self._opt_device_shardings)
             updates, new_opt = self.optimizer.update(
-                grads, state.opt_state, state.params
+                grads, opt_in, state.params
             )
+            if self.cpu_offload:
+                new_opt = jax.device_put(new_opt, self._opt_host_shardings)
             updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
             return optax.apply_updates(state.params, updates), new_opt
 
