@@ -10,7 +10,8 @@ BASELINE.md). Prints ONE JSON line:
 
 Runs on whatever jax.devices() offers (one real TPU chip under the driver;
 CPU elsewhere). Environment overrides: BENCH_MODEL_SIZE, BENCH_BATCH_SIZE,
-BENCH_SEQ_LEN, BENCH_STEPS, BENCH_ACCUM, BENCH_FLASH=0/1.
+BENCH_SEQ_LEN, BENCH_STEPS, BENCH_ACCUM, BENCH_FLASH=0/1, BENCH_REMAT=0/1
+(remat defaults on for medium/large/xl, matching the reference's configs).
 """
 
 from __future__ import annotations
@@ -36,12 +37,15 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     accum = int(os.environ.get("BENCH_ACCUM", "1"))
     use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
+    remat_default = "0" if model_size == "small" else "1"
+    remat = os.environ.get("BENCH_REMAT", remat_default) == "1"
 
     on_tpu = jax.devices()[0].platform == "tpu"
     model_config = GPTConfig.preset(
         model_size,
         max_seq_len=seq_len,
         use_flash_attention=use_flash,
+        gradient_checkpointing=remat,
         # Full reference-default dropout: the flash kernel implements
         # attention-weight dropout in-kernel (counter-based mask), so the
         # flash memory profile holds with dropout active.

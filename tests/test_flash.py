@@ -157,6 +157,59 @@ class TestKernelDropout:
             flash_attention(q, k, v, interpret=True, dropout_rate=0.1)
 
 
+class TestFusedRope:
+    """RoPE fused into the kernel vs external rotation + reference path."""
+
+    def _qkv_rope(self, b=2, s=256, h=2, d=32):
+        from tpu_trainer.ops.rope import apply_rotary_pos_emb, rope_tables
+
+        q, k, v = _rand_qkv(jax.random.PRNGKey(20), b, s, h, d)
+        cos, sin = rope_tables(s, d)
+        return q, k, v, cos, sin, apply_rotary_pos_emb
+
+    def test_forward_matches_external_rope(self):
+        # Multi-block grid (s=512, 128-blocks): exercises the per-block
+        # cos/sin offsets, not just offset-zero.
+        q, k, v, cos, sin, rot = self._qkv_rope(s=512)
+        qr, kr = rot(q, k, cos, sin)
+        expected = reference_attention(qr, kr, v)
+        got = flash_attention(
+            q, k, v, interpret=True, rope=(cos, sin),
+            block_q=128, block_k=128,
+        )
+        np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+    def test_gradients_match_external_rope(self):
+        # Multi-block grid: rope-path dq accumulation across kv grid steps.
+        q, k, v, cos, sin, rot = self._qkv_rope(b=1, s=512, h=1, d=32)
+
+        def loss_fused(q, k, v):
+            out = flash_attention(
+                q, k, v, interpret=True, rope=(cos, sin),
+                block_q=128, block_k=128,
+            )
+            return jnp.sum(jnp.sin(out))
+
+        def loss_ext(q, k, v):
+            qr, kr = rot(q, k, cos, sin)
+            return jnp.sum(jnp.sin(reference_attention(qr, kr, v)))
+
+        g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+        g_ext = jax.grad(loss_ext, argnums=(0, 1, 2))(q, k, v)
+        for got, expected, name in zip(g_fused, g_ext, "qkv"):
+            np.testing.assert_allclose(
+                got, expected, atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_fallback_seq_applies_rope(self):
+        # seq=100 takes the XLA fallback; rope must still be applied.
+        q, k, v, cos, sin, rot = self._qkv_rope(b=1, s=100, h=1, d=32)
+        qr, kr = rot(q, k, cos, sin)
+        expected = reference_attention(qr, kr, v)
+        got = flash_attention(q, k, v, interpret=True, rope=(cos, sin))
+        np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+
 def test_causal_masking_is_exact():
     # Token t's output must not change when future tokens change.
     b, s, h, d = 1, 256, 1, 64

@@ -59,42 +59,13 @@ class RMSNorm(nn.Module):
         return (x32 * rms * weight).astype(self.dtype)
 
 
-def rope_tables(
-    seq_len: int, dim: int, base: float = 10000.0
-) -> Tuple[jax.Array, jax.Array]:
-    """Precompute RoPE cos/sin tables, shape ``[seq_len, dim]``.
-
-    Matches the reference cache construction (``gpt.py:76-93``): inverse
-    frequencies over even indices, angles tiled as ``concat(freqs, freqs)``.
-    Computed fresh under jit (constant-folded by XLA) — never checkpointed.
-    """
-    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv_freq)
-    emb = jnp.concatenate([freqs, freqs], axis=-1)
-    return jnp.cos(emb), jnp.sin(emb)
-
-
-def rotate_half(x: jax.Array) -> jax.Array:
-    """``[a, b, c, d] -> [-c, -d, a, b]`` (reference ``gpt.py:100-117``)."""
-    half = x.shape[-1] // 2
-    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
-
-
-def apply_rotary_pos_emb(
-    q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array
-) -> Tuple[jax.Array, jax.Array]:
-    """Rotate q/k by position (reference ``gpt.py:120-147``).
-
-    q, k: ``[batch, seq, heads, head_dim]``; cos, sin: ``[seq, head_dim]``.
-    Applied in float32, cast back to the inputs' dtype.
-    """
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
-    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
-    q_rot = q32 * cos + rotate_half(q32) * sin
-    k_rot = k32 * cos + rotate_half(k32) * sin
-    return q_rot.astype(q.dtype), k_rot.astype(k.dtype)
+# RoPE lives in ops/rope.py (shared with the attention dispatch and the
+# fused kernel); re-exported here for API continuity.
+from tpu_trainer.ops.rope import (  # noqa: E402,F401
+    apply_rotary_pos_emb,
+    rope_tables,
+    rotate_half,
+)
 
 
 class CausalSelfAttention(nn.Module):
@@ -136,8 +107,6 @@ class CausalSelfAttention(nn.Module):
             out = self._decode_attention(q, k, v)
         else:
             cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
-            q, k = apply_rotary_pos_emb(q, k, cos, sin)
-
             needs_rng = cfg.attention_dropout > 0.0 and not deterministic
             dropout_rng = self.make_rng("dropout") if needs_rng else None
             sp_ctx = ring.current_context()
@@ -149,13 +118,21 @@ class CausalSelfAttention(nn.Module):
                         "attention; set attention_dropout=0 for sequence "
                         "parallelism"
                     )
+                q, k = apply_rotary_pos_emb(q, k, cos, sin)
                 out = ring.ring_attention(q, k, v, sp_ctx.mesh, sp_ctx.axis_name)
-            else:
-                attn_fn = (
-                    flash_attention if cfg.use_flash_attention
-                    else reference_attention
+            elif cfg.use_flash_attention:
+                # RoPE rides into the kernel (rotation happens in VMEM on
+                # TPU; external otherwise — ops/attention.py decides).
+                out = flash_attention(
+                    q, k, v,
+                    dropout_rate=cfg.attention_dropout,
+                    deterministic=deterministic,
+                    dropout_rng=dropout_rng,
+                    rope=(cos, sin),
                 )
-                out = attn_fn(
+            else:
+                q, k = apply_rotary_pos_emb(q, k, cos, sin)
+                out = reference_attention(
                     q, k, v,
                     dropout_rate=cfg.attention_dropout,
                     deterministic=deterministic,

@@ -64,14 +64,16 @@ def flash_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
+    rope: Optional[tuple] = None,
 ) -> jax.Array:
     """Fused causal attention (reference flash path, ``gpt.py:199-206``).
 
     Dispatches to the Pallas TPU kernel when running on TPU — including
-    training with attention-weight dropout, which the kernel implements with
-    a counter-based in-kernel mask (``ops/flash.py``; no [seq, seq] buffer).
-    Off-TPU, uses XLA's fused attention, with the manual path covering the
-    dropout case (same semantics as the reference's manual branch).
+    training with attention-weight dropout (counter-based in-kernel mask)
+    and RoPE fused into the kernel when ``rope=(cos, sin)`` is given.
+    Off-TPU, applies rope externally and uses XLA's fused attention, with
+    the manual path covering the dropout case (same semantics as the
+    reference's manual branch).
     """
     active_dropout = dropout_rate > 0.0 and not deterministic
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
@@ -85,7 +87,12 @@ def flash_attention(
                 q, k, v, causal=True,
                 dropout_rate=dropout_rate if active_dropout else 0.0,
                 dropout_rng=dropout_rng,
+                rope=rope,
             )
+    if rope is not None:
+        from tpu_trainer.ops.rope import apply_rotary_pos_emb
+
+        q, k = apply_rotary_pos_emb(q, k, rope[0], rope[1])
     if active_dropout:
         return reference_attention(
             q, k, v,

@@ -84,15 +84,39 @@ def _seed_from_ref(seed_ref):
     return seed_ref[0, 0]
 
 
+def _rotate(x, cos, sin, out_dtype):
+    """RoPE rotation of one block (``x [n, d]``, ``cos/sin [n, d]`` f32):
+    ``x*cos + rotate_half(x)*sin``, f32 math, cast to ``out_dtype``."""
+    half = x.shape[-1] // 2
+    x32 = x.astype(jnp.float32)
+    rx = jnp.concatenate([-x32[..., half:], x32[..., :half]], axis=-1)
+    return (x32 * cos + rx * sin).astype(out_dtype)
+
+
+def _unrotate_grad(g, cos, sin):
+    """VJP of ``_rotate`` w.r.t. x applied to cotangent ``g`` (f32):
+    ``g*cos + rotate_half^T(g*sin)`` where ``rotate_half^T([a,b]) = [b,-a]``."""
+    half = g.shape[-1] // 2
+    gs = g * sin
+    rt = jnp.concatenate([gs[..., half:], -gs[..., :half]], axis=-1)
+    return g * cos + rt
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                *, block_k, scale, causal, dropout_rate):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
+                block_k, scale, causal, dropout_rate, fuse_rope):
     # q_ref: [1, 1, block_q, d]; k_ref/v_ref: [1, 1, seq, d];
     # lse_ref: [1, 1, 1, seq] (full row, written blockwise).
+    # With fuse_rope, cos/sin [seq, d] ride along and q/k blocks rotate in
+    # VMEM — no rotated copies ever hit HBM.
+    if fuse_rope:
+        cos_ref, sin_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     block_q = q_ref.shape[2]
     d = q_ref.shape[3]
     seq = k_ref.shape[2]
@@ -105,6 +129,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     # bf16 x bf16 -> f32 at full rate, while f32 x f32 matmuls cost ~8x.
     # All softmax state is f32 via preferred_element_type.
     q = q_ref[0, 0, :, :]  # [bq, d]
+    if fuse_rope:
+        q = _rotate(q, cos_ref[pl.ds(q_start, block_q), :],
+                    sin_ref[pl.ds(q_start, block_q), :], q_ref.dtype)
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -114,6 +141,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m, l, acc = carry
         k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :]
         v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :]
+        if fuse_rope:
+            k = _rotate(k, cos_ref[pl.ds(ik * block_k, block_k), :],
+                        sin_ref[pl.ds(ik * block_k, block_k), :], k_ref.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk] f32
@@ -153,11 +183,16 @@ def _seed_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _flash_forward(q, k, v, seed_f, *, causal, block_q, block_k, interpret,
-                   dropout_rate):
+def _rope_specs(s, d):
+    return [pl.BlockSpec((s, d), lambda ib, ih, i: (0, 0))] * 2
+
+
+def _flash_forward(q, k, v, seed_f, rope, *, causal, block_q, block_k,
+                   interpret, dropout_rate):
     # q, k, v: BHSD [b, h, s, d]; seed_f: (1,1) float32 bit-carrier (floats
     # so custom_vjp has a well-defined cotangent; re-bitcast to uint32 here,
     # outside the kernel — Mosaic can't bitcast scalars in-kernel).
+    # rope: None or (cos, sin) [s, d] f32.
     seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -165,20 +200,23 @@ def _flash_forward(q, k, v, seed_f, *, causal, block_q, block_k, interpret,
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0))
     row_spec = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, iq: (ib, ih, 0, 0))
+    fuse_rope = rope is not None
+    rope_args = tuple(rope) if fuse_rope else ()
     o, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, scale=scale, causal=causal,
-            dropout_rate=dropout_rate,
+            dropout_rate=dropout_rate, fuse_rope=fuse_rope,
         ),
         grid=grid,
-        in_specs=[_seed_spec(), q_spec, kv_spec, kv_spec],
+        in_specs=[_seed_spec(), q_spec, kv_spec, kv_spec]
+        + (_rope_specs(s, d) if fuse_rope else []),
         out_specs=[q_spec, row_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(seed_f, q, k, v)
+    )(seed_f, q, k, v, *rope_args)
     return o, lse
 
 
@@ -187,61 +225,28 @@ def _flash_forward(q, k, v, seed_f, *, causal, block_q, block_k, interpret,
 # --------------------------------------------------------------------------
 
 
-def _dq_kernel(
-    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, block_k, scale, causal, dropout_rate
+def _bwd_fused_kernel(
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q, scale, causal, dropout_rate, fuse_rope,
 ):
-    block_q = q_ref.shape[2]
-    d = q_ref.shape[3]
-    seq = k_ref.shape[2]
-    iq = pl.program_id(2)
-    q_start = iq * block_q
-    seed = _seed_from_ref(seed_ref)
-    salt = _block_salt()
+    """Single-pass backward: grid ``(b, h, seq // block_k)``.
 
-    q = q_ref[0, 0, :, :]
-    do = do_ref[0, 0, :, :]
-    lse = lse_ref[0, 0, 0, pl.ds(q_start, block_q)][:, None]      # [bq, 1]
-    delta = delta_ref[0, 0, 0, pl.ds(q_start, block_q)][:, None]  # [bq, 1]
+    Each program owns one K/V block, streams the (causally relevant) query
+    blocks once, and from a single score/probability computation produces
+    its dk/dv block *and* the partial dq contributions. dq's BlockSpec index
+    is constant in the kv grid dimension, so the full-row dq block stays
+    resident in VMEM and accumulates across sequential grid steps (zeroed at
+    the first kv block). Compared to separate dq and dk/dv kernels this
+    halves the backward's score matmuls and q/do reads.
 
-    def body(ik, dq):
-        k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            * scale
-        )
-        if causal:
-            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk] (normalized)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if dropout_rate > 0.0:
-            # d/ds with dropout m: ds = p * (m/(1-r) * (do.v) - delta);
-            # the mask regenerates bit-identically from the same counters.
-            keep = _keep_mask(seed, salt, q_start, ik * block_k,
-                              block_q, block_k, seq, dropout_rate)
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta)
-        return dq + jnp.dot(ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
-
-    if causal:
-        num_k = (q_start + block_q + block_k - 1) // block_k
+    With ``fuse_rope``, q/k blocks are re-rotated in VMEM for the score
+    recomputation; dq/dk leave the kernel in *rotated* space and the caller
+    applies the rotation's transpose (``_unrotate_grad``).
+    """
+    if fuse_rope:
+        cos_ref, sin_ref, dq_ref, dk_ref, dv_ref = rest
     else:
-        num_k = seq // block_k
-    dq = jax.lax.fori_loop(0, num_k, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0, :, :] = (dq * scale).astype(dq_ref.dtype)
-
-
-def _dkv_kernel(
-    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q, scale, causal, dropout_rate,
-):
+        dq_ref, dk_ref, dv_ref = rest
     block_k = k_ref.shape[2]
     d = k_ref.shape[3]
     seq = q_ref.shape[2]
@@ -250,18 +255,29 @@ def _dkv_kernel(
     seed = _seed_from_ref(seed_ref)
     salt = _block_salt()
 
+    @pl.when(ik == 0)
+    def _zero_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
     k = k_ref[0, 0, :, :]
     v = v_ref[0, 0, :, :]
+    if fuse_rope:
+        k = _rotate(k, cos_ref[pl.ds(k_start, block_k), :],
+                    sin_ref[pl.ds(k_start, block_k), :], k_ref.dtype)
 
     def body(iq, carry):
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(iq * block_q, block_q), :]
         do = do_ref[0, 0, pl.ds(iq * block_q, block_q), :]
+        if fuse_rope:
+            q = _rotate(q, cos_ref[pl.ds(iq * block_q, block_q), :],
+                        sin_ref[pl.ds(iq * block_q, block_q), :], q_ref.dtype)
         lse = lse_ref[0, 0, 0, pl.ds(iq * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, 0, pl.ds(iq * block_q, block_q)][:, None]
         s = (
             jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
             * scale
         )  # [bq, bk]
@@ -269,7 +285,7 @@ def _dkv_kernel(
             row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, _NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+        p = jnp.exp(s - lse)                       # [bq, bk] (normalized)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -289,6 +305,11 @@ def _dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        dq_part = jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        ) * scale
+        sl = pl.ds(iq * block_q, block_q)
+        dq_ref[0, 0, sl, :] += dq_part.astype(dq_ref.dtype)
         return dk_new, dv_new
 
     num_q = seq // block_q
@@ -301,8 +322,8 @@ def _dkv_kernel(
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, seed_f, *, causal, block_q, block_k,
-                    interpret, dropout_rate):
+def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
+                    block_k, interpret, dropout_rate):
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term.
@@ -314,30 +335,37 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, *, causal, block_q, block_k,
     blk = lambda n: pl.BlockSpec((1, 1, n, d), lambda ib, ih, i: (ib, ih, i, 0))
     full = pl.BlockSpec((1, 1, s, d), lambda ib, ih, i: (ib, ih, 0, 0))
     row = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, i: (ib, ih, 0, 0))
+    fuse_rope = rope is not None
+    rope_args = tuple(rope) if fuse_rope else ()
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=scale,
-                          causal=causal, dropout_rate=dropout_rate),
-        grid=(b, h, s // block_q),
-        in_specs=[_seed_spec(), blk(block_q), full, full, blk(block_q), row, row],
-        out_specs=blk(block_q),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-        interpret=interpret,
-    )(seed_f, q, k, v, do, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, block_q=block_q, scale=scale,
-                          causal=causal, dropout_rate=dropout_rate),
+    # Fused single pass; dq accumulates in f32 across kv-block grid steps
+    # (its block index is constant in that dimension, so it stays in VMEM).
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, block_q=block_q, scale=scale,
+                          causal=causal, dropout_rate=dropout_rate,
+                          fuse_rope=fuse_rope),
         grid=(b, h, s // block_k),
-        in_specs=[_seed_spec(), full, blk(block_k), blk(block_k), full, row, row],
-        out_specs=[blk(block_k), blk(block_k)],
+        in_specs=[_seed_spec(), full, blk(block_k), blk(block_k), full, row, row]
+        + (_rope_specs(s, d) if fuse_rope else []),
+        out_specs=[full, blk(block_k), blk(block_k)],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            # Under fused rope dk leaves in rotated space and is unrotated
+            # below; keep it f32 so it rounds once, like dq.
+            jax.ShapeDtypeStruct(
+                (b, h, s, d), jnp.float32 if fuse_rope else k.dtype
+            ),
             jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
         ],
         interpret=interpret,
-    )(seed_f, q, k, v, do, lse, delta)
-    return dq, dk, dv
+    )(seed_f, q, k, v, do, lse, delta, *rope_args)
+    if fuse_rope:
+        # dq/dk are in rotated space; apply the rotation's transpose.
+        cos, sin = rope
+        cos4, sin4 = cos[None, None], sin[None, None]
+        dq = _unrotate_grad(dq, cos4, sin4)
+        dk = _unrotate_grad(dk, cos4, sin4).astype(k.dtype)
+    return dq.astype(q.dtype), dk, dv
 
 
 # --------------------------------------------------------------------------
@@ -347,7 +375,8 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, *, causal, block_q, block_k,
 
 @functools.lru_cache(maxsize=None)
 def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
-                dropout_rate: float, num_heads: int, head_dim: int):
+                dropout_rate: float, num_heads: int, head_dim: int,
+                fuse_rope: bool):
     """custom_vjp'd kernel entry over *folded* ``[b, s, h*d]`` operands.
 
     The fold matters for memory: with head_dim 64, BSHD/BHSD tensors pad
@@ -355,7 +384,8 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
     activation — q/k/v/o per layer). Saving residuals as ``[b, s, h*d]``
     keeps the minor dim at hidden size, so the autodiff-saved buffers are
     unpadded; the BHSD form the kernels need exists only transiently around
-    the pallas calls.
+    the pallas calls. With ``fuse_rope``, residuals are additionally
+    *pre-rotation* — the rotated q/k never exist outside VMEM.
     """
     kw = dict(causal=causal, block_q=block_q, block_k=block_k,
               interpret=interpret, dropout_rate=dropout_rate)
@@ -369,27 +399,31 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         b, _, s, _ = x4.shape
         return x4.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
-    def _fwd(q3, k3, v3, seed_f):
+    def _fwd(q3, k3, v3, seed_f, cos, sin):
+        rope = (cos, sin) if fuse_rope else None
         o, lse = _flash_forward(
-            to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), seed_f, **kw
+            to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), seed_f, rope, **kw
         )
         return to_flat(o), lse
 
     @jax.custom_vjp
-    def flash(q3, k3, v3, seed_f):
-        return _fwd(q3, k3, v3, seed_f)[0]
+    def flash(q3, k3, v3, seed_f, cos, sin):
+        return _fwd(q3, k3, v3, seed_f, cos, sin)[0]
 
-    def fwd(q3, k3, v3, seed_f):
-        o3, lse = _fwd(q3, k3, v3, seed_f)
-        return o3, (q3, k3, v3, o3, lse, seed_f)
+    def fwd(q3, k3, v3, seed_f, cos, sin):
+        o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)
+        return o3, (q3, k3, v3, o3, lse, seed_f, cos, sin)
 
     def bwd(res, do3):
-        q3, k3, v3, o3, lse, seed_f = res
+        q3, k3, v3, o3, lse, seed_f, cos, sin = res
+        rope = (cos, sin) if fuse_rope else None
         dq, dk, dv = _flash_backward(
             to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), to_bhsd(o3), lse,
-            to_bhsd(do3), seed_f, **kw
+            to_bhsd(do3), seed_f, rope, **kw
         )
-        return to_flat(dq), to_flat(dk), to_flat(dv), jnp.zeros_like(seed_f)
+        return (to_flat(dq), to_flat(dk), to_flat(dv),
+                jnp.zeros_like(seed_f), jnp.zeros_like(cos),
+                jnp.zeros_like(sin))
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -406,16 +440,19 @@ def flash_attention(
     interpret: bool = False,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    rope: Optional[tuple] = None,
 ) -> jax.Array:
     """Blockwise causal flash attention; BSHD in, BSHD out.
 
     ``dropout_rate > 0`` (with a PRNG key) applies attention-weight dropout
     *inside* the kernel via a counter-based mask — no [seq, seq] mask array
     ever exists, and training with the reference's default attention dropout
-    keeps the flash memory profile. Falls back to XLA's fused attention when
-    the sequence length doesn't tile (the kernel requires
-    ``seq % block == 0``) — e.g. odd-length generate windows (dropout is
-    inference-off there by construction).
+    keeps the flash memory profile. ``rope=(cos, sin)`` ([seq, head_dim]
+    f32 tables) fuses the rotary embedding into the kernel: q/k rotate in
+    VMEM, never materializing rotated copies in HBM. Falls back to XLA's
+    fused attention when the sequence length doesn't tile (the kernel
+    requires ``seq % block == 0``) — e.g. odd-length generate windows —
+    applying rope externally there.
     """
     b, s, h, d = q.shape
     # Largest block <= the requested size that divides the sequence, so e.g.
@@ -426,6 +463,10 @@ def flash_attention(
     block_k = next((blk for blk in (block_k, 256, 128) if blk <= s and s % blk == 0),
                    block_k)
     if s % block_q != 0 or s % block_k != 0 or s < 8:
+        if rope is not None:
+            from tpu_trainer.ops.rope import apply_rotary_pos_emb
+
+            q, k = apply_rotary_pos_emb(q, k, rope[0], rope[1])
         if dropout_rate > 0.0:
             # The XLA fused path has no attention dropout; keep the
             # configured semantics via the jnp reference path.
@@ -447,13 +488,19 @@ def flash_attention(
     else:
         seed_bits = jnp.uint32(0)
     seed_f = jax.lax.bitcast_convert_type(seed_bits, jnp.float32).reshape(1, 1)
+    fuse_rope = rope is not None
+    if fuse_rope:
+        cos, sin = rope[0].astype(jnp.float32), rope[1].astype(jnp.float32)
+    else:
+        cos = sin = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
     fn = _make_flash(
-        causal, block_q, block_k, interpret, float(dropout_rate), h, d
+        causal, block_q, block_k, interpret, float(dropout_rate), h, d,
+        fuse_rope,
     )
     # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals);
     # the kernel-internal layout is BHSD for the (seq, head_dim) tiling.
     out = fn(
         q.reshape(b, s, h * d), k.reshape(b, s, h * d),
-        v.reshape(b, s, h * d), seed_f,
+        v.reshape(b, s, h * d), seed_f, cos, sin,
     )
     return out.reshape(b, s, h, d)

@@ -1,0 +1,52 @@
+"""Rotary position embeddings (reference ``gpt.py:70-147`` — SURVEY.md C3).
+
+Lives in ``ops`` (not ``models``) so the attention dispatch can apply it
+without an import cycle: the fused flash path rotates q/k *inside* the
+Pallas kernel, while the jnp/ring paths rotate here first. Tables are
+recomputed under jit (XLA constant-folds them) and never checkpointed — the
+reference persists them as buffers in every state_dict (SURVEY.md §2.1 b8).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_tables(
+    seq_len: int, dim: int, base: float = 10000.0
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables, shape ``[seq_len, dim]``.
+
+    Matches the reference cache construction (``gpt.py:76-93``): inverse
+    frequencies over even indices, angles tiled as ``concat(freqs, freqs)``.
+    """
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    """``[a, b, c, d] -> [-c, -d, a, b]`` (reference ``gpt.py:100-117``)."""
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rotary_pos_emb(
+    q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Rotate q/k by position (reference ``gpt.py:120-147``).
+
+    q, k: ``[batch, seq, heads, head_dim]``; cos, sin: ``[seq, head_dim]``.
+    Applied in float32, cast back to the inputs' dtype.
+    """
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_rot = q32 * cos + rotate_half(q32) * sin
+    k_rot = k32 * cos + rotate_half(k32) * sin
+    return q_rot.astype(q.dtype), k_rot.astype(k.dtype)
