@@ -36,8 +36,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 SEQ_AXIS = "sequence"

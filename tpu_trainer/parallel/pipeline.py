@@ -1,0 +1,132 @@
+"""Pipeline parallelism: a GPipe-style microbatch schedule over a mesh axis.
+
+Pipeline parallelism is an aspirational bullet in the reference
+(``README.md:10`` — never implemented; SURVEY.md §2). Here it is a working
+SPMD schedule, built the TPU way: no per-stage processes or RPC — one
+``shard_map`` over a ``stage`` mesh axis, with activations handed to the
+next stage by ``lax.ppermute`` over ICI and the whole schedule expressed as
+a ``lax.scan`` (so it jits once and differentiates end-to-end; the backward
+pass is the reverse pipeline, derived by AD).
+
+Schedule (classic GPipe):
+
+- The layer stack ``[L, ...]`` is split into ``S`` contiguous stages
+  (``L/S`` layers each — the stacked-parameter layout from ``nn.scan`` makes
+  this a pure sharding of the leading axis).
+- The batch is split into ``M`` microbatches. At step ``t`` of ``M+S-1``,
+  stage ``s`` processes microbatch ``t - s`` (bubble fraction
+  ``(S-1)/(M+S-1)``).
+- Stage 0 feeds from the microbatch queue; stage ``S-1`` writes results.
+  Between steps every stage ppermutes its output to its right neighbor.
+
+`pipeline_forward` is deliberately model-agnostic: it takes the stacked
+per-layer params and a ``block_fn(layer_params, x) -> x``. The embedding /
+final-norm / loss stay outside (they are cheap and replicated).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+STAGE_AXIS = "stage"
+
+
+def pipeline_forward(
+    stacked_params: Any,
+    x: jax.Array,
+    block_fn: Callable[[Any, jax.Array], jax.Array],
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = STAGE_AXIS,
+) -> jax.Array:
+    """Run ``x`` through the full layer stack with a GPipe schedule.
+
+    Args:
+      stacked_params: pytree whose leaves lead with the layer axis ``[L, ...]``
+        (the ``nn.scan`` layout); logically global, sharded over ``axis_name``.
+      x: ``[batch, seq, hidden]`` activations; batch must divide into
+        ``num_microbatches``.
+      block_fn: applies ONE layer: ``block_fn(params_of_layer, x) -> x``.
+      mesh: mesh containing ``axis_name``.
+      num_microbatches: M; more microbatches -> smaller pipeline bubble.
+
+    Returns activations after all L layers, ``[batch, seq, hidden]``.
+    """
+    S = mesh.shape[axis_name]
+    b, s, h = x.shape
+    if b % num_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by M={num_microbatches}")
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % S != 0:
+        raise ValueError(
+            f"num_layers {n_layers} not divisible by {S} pipeline stages"
+        )
+    mb = b // num_microbatches
+    M = num_microbatches
+
+    def staged(local_params, x_local):
+        # local_params: leaves [L/S, ...] (this stage's layers).
+        # x_local: full batch [b, s, h] (batch stays replicated over the
+        # stage axis; only the *stage* of processing differs).
+        stage = lax.axis_index(axis_name)
+        micro = x_local.reshape(M, mb, s, h)
+
+        def run_stage(xm):
+            def one_layer(carry, layer_params):
+                return block_fn(layer_params, carry), None
+
+            out, _ = lax.scan(one_layer, xm, local_params)
+            return out
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        outputs0 = jnp.zeros((M, mb, s, h), x_local.dtype)
+        # `moving` is each stage's current inbound activation slot.
+        moving0 = jnp.zeros((mb, s, h), x_local.dtype)
+
+        def step(carry, t):
+            moving, outputs = carry
+            # Stage 0 ingests microbatch t (when in range); others take the
+            # activation that arrived from the left neighbor.
+            feed_idx = jnp.clip(t, 0, M - 1)
+            x_in = jnp.where(stage == 0, micro[feed_idx], moving)
+            y = run_stage(x_in)
+            # Last stage stores microbatch t - (S-1) when it's real.
+            out_idx = t - (S - 1)
+            store = jnp.logical_and(stage == S - 1, out_idx >= 0)
+            outputs = lax.cond(
+                store,
+                lambda o: lax.dynamic_update_slice(
+                    o, y[None], (jnp.maximum(out_idx, 0), 0, 0, 0)
+                ),
+                lambda o: o,
+                outputs,
+            )
+            moving = lax.ppermute(y, axis_name, perm)
+            return (moving, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            step, (moving0, outputs0), jnp.arange(M + S - 1)
+        )
+        # Only the last stage holds real outputs; broadcast them to every
+        # stage so the result is replicated over the axis (psum of a
+        # one-hot-masked buffer).
+        mask = (stage == S - 1).astype(outputs.dtype)
+        outputs = lax.psum(outputs * mask, axis_name)
+        return outputs.reshape(b, s, h)
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
+    )
+    fn = shard_map(
+        staged,
+        mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return fn(stacked_params, x)
