@@ -138,9 +138,9 @@ def ring_attention(
         lambda q, k, v: _ring_attention_local(
             q, k, v, axis_name=axis_name, sp=sp, scale=scale
         ),
-        mesh,
+        mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(q, k, v)

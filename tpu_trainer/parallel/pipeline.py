@@ -124,9 +124,9 @@ def pipeline_forward(
     )
     fn = shard_map(
         staged,
-        mesh,
+        mesh=mesh,
         in_specs=(layer_specs, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     return fn(stacked_params, x)
