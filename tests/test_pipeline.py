@@ -87,7 +87,11 @@ class TestTransformerBlockPipeline:
         block = TransformerBlock(cfg)
 
         def block_fn(p, x):
-            return block.apply({"params": p}, x)[0]
+            # Block carry is (x, moe_aux); aux is zero for the dense model.
+            (out, _), _ = block.apply(
+                {"params": p}, (x, jnp.zeros((), jnp.float32))
+            )
+            return out
 
         x = jax.random.normal(jax.random.PRNGKey(3), (4, 16, 32))
         want = _sequential(layer_params, x, block_fn)

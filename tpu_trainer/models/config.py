@@ -64,6 +64,14 @@ class GPTConfig:
     # RoPE base frequency (reference gpt.py:76 hardcodes 10000)
     rope_theta: float = 10000.0
 
+    # Mixture-of-Experts (0 = dense; beyond-reference model family). When
+    # num_experts > 0 every block's feed-forward becomes a Switch-style
+    # top-1 routed expert SwiGLU (models/moe.py), with experts shardable
+    # over the mesh's 'expert' axis.
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
     # Optimization flags (reference config.py:30-32)
     use_flash_attention: bool = False
     gradient_checkpointing: bool = False
@@ -148,11 +156,16 @@ class GPTConfig:
         """Exact parameter count of the actual model.
 
         embed (tied with lm_head): V*H
-        per layer: attention 4*H^2 (q/k/v/o, no bias) + SwiGLU 3*H*I
+        per layer: attention 4*H^2 (q/k/v/o, no bias)
+                   + FFN: SwiGLU 3*H*I (dense) or E*3*H*I + H*E router (MoE)
                    + 2 RMSNorm weight vectors (2*H)
         final RMSNorm: H
         """
         h, i = self.hidden_size, self.intermediate_size
         embed = self.vocab_size * h
-        per_layer = 4 * h * h + 3 * h * i + 2 * h
+        if self.num_experts > 0:
+            ffn = self.num_experts * 3 * h * i + h * self.num_experts
+        else:
+            ffn = 3 * h * i
+        per_layer = 4 * h * h + ffn + 2 * h
         return embed + self.num_layers * per_layer + h

@@ -221,7 +221,9 @@ class TransformerBlock(nn.Module):
     """Pre-norm block with two residuals (reference ``gpt.py:286-316``).
 
     Written in scan form: ``__call__(carry, _) -> (carry, None)`` so a single
-    traced block is iterated ``num_layers`` times by ``nn.scan``.
+    traced block is iterated ``num_layers`` times by ``nn.scan``. The carry
+    is ``(x, aux)`` — ``aux`` accumulates the MoE load-balance loss across
+    layers (zero for the dense model).
     """
 
     config: GPTConfig
@@ -229,8 +231,9 @@ class TransformerBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array, _unused=None):
+    def __call__(self, carry, _unused=None):
         cfg = self.config
+        x, aux = carry
         residual = x
         h = RMSNorm(dtype=cfg.compute_dtype, name="input_layernorm")(x)
         h = CausalSelfAttention(cfg, name="attention")(
@@ -240,9 +243,15 @@ class TransformerBlock(nn.Module):
 
         residual = x
         h = RMSNorm(dtype=cfg.compute_dtype, name="post_attention_layernorm")(x)
-        h = MLP(cfg, name="mlp")(h, self.deterministic)
+        if cfg.num_experts > 0:
+            from tpu_trainer.models.moe import MoEMLP
+
+            h, layer_aux = MoEMLP(cfg, name="moe_mlp")(h, self.deterministic)
+            aux = aux + layer_aux
+        else:
+            h = MLP(cfg, name="mlp")(h, self.deterministic)
         x = residual + h
-        return x, None
+        return (x, aux), None
 
 
 class GPT(nn.Module):
@@ -296,9 +305,9 @@ class GPT(nn.Module):
             split_rngs={"params": True, "dropout": True},
             length=cfg.num_layers,
         )
-        x, _ = layers(
+        (x, moe_aux), _ = layers(
             cfg, deterministic=not train, decode=decode, name="layers"
-        )(x, None)
+        )((x, jnp.zeros((), jnp.float32)), None)
 
         x = RMSNorm(dtype=cfg.compute_dtype, name="norm")(x)
         # Weight tying (reference gpt.py:342): logits via the embedding matrix.
@@ -313,6 +322,9 @@ class GPT(nn.Module):
             loss = jnp.mean(
                 optax_softmax_cross_entropy(shift_logits, shift_labels)
             )
+            if cfg.num_experts > 0:
+                # MoE load-balance auxiliary (mean over layers).
+                loss = loss + cfg.moe_aux_weight * moe_aux / cfg.num_layers
         return logits, loss
 
 

@@ -1,0 +1,114 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+Beyond-reference capability (the reference has a single dense model family;
+SURVEY.md §2 lists EP as absent): a Switch-Transformer-style top-1 routed
+MoE that drops into the TransformerBlock in place of the dense SwiGLU when
+``GPTConfig.num_experts > 0``.
+
+TPU-native shape: experts are *stacked* (``[E, ...]`` parameter leaves, like
+the layer stack) and the whole layer is einsums — dispatch/combine are
+one-hot matmuls, so the MXU does the routing and GSPMD does the expert
+parallelism: sharding the expert leaves over the ``expert`` mesh axis makes
+XLA emit the all-to-all between data-sharded tokens and expert-sharded FFNs
+automatically. No collective appears in this file.
+
+Mechanics (Switch Transformer, arXiv:2101.03961):
+
+- router: ``logits [T, E]`` in f32; top-1 expert per token.
+- capacity ``C = ceil(T/E * capacity_factor)``; per-expert positions come
+  from a cumsum over the one-hot assignment; tokens beyond capacity are
+  dropped (contribute zero, like the paper).
+- combine weight = router probability of the chosen expert.
+- aux load-balance loss ``E * sum_e f_e * p_e`` (fraction of tokens routed
+  to e times mean router prob of e), returned for the model to add with
+  ``moe_aux_weight``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_trainer.models.config import GPTConfig
+
+
+class MoEMLP(nn.Module):
+    """Top-1 routed expert SwiGLU (replaces ``MLP`` when experts are on)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, deterministic: bool = True
+    ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        E = cfg.num_experts
+        b, s, H = x.shape
+        T = b * s
+        I = cfg.intermediate_size
+        if T <= 2 * E:
+            # Tiny-token regime (single-token KV decode: T = batch): the
+            # statistical capacity rule degenerates (C~1 would zero out any
+            # token colliding on an expert). Give every token a slot.
+            C = T
+        else:
+            C = max(1, math.ceil(T / E * cfg.expert_capacity_factor))
+
+        xt = x.reshape(T, H)
+
+        # Router in f32 (standard for stability).
+        router_logits = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            name="router",
+        )(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
+        expert_idx = jnp.argmax(probs, axis=-1)                 # [T]
+        assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+
+        # Aux load-balance loss uses pre-capacity assignment fractions.
+        frac = jnp.mean(assign, axis=0)                         # [E]
+        mean_prob = jnp.mean(probs, axis=0)                     # [E]
+        aux = E * jnp.sum(frac * mean_prob)
+
+        # Position of each token within its expert's queue; drop past C.
+        pos = jnp.cumsum(assign, axis=0) - assign               # [T, E]
+        keep = (pos < C).astype(jnp.float32) * assign
+        gate = jnp.sum(probs * keep, axis=-1)                   # [T]
+        pos_idx = jnp.sum(pos * assign, axis=-1).astype(jnp.int32)
+
+        # dispatch [T, E, C]: 1 at (t, expert(t), pos(t)) for kept tokens.
+        dispatch = (
+            keep[:, :, None] * jax.nn.one_hot(pos_idx, C, dtype=jnp.float32)[:, None, :]
+        )
+
+        dtype = cfg.compute_dtype
+        expert_in = jnp.einsum(
+            "tec,th->ech", dispatch.astype(dtype), xt.astype(dtype)
+        )  # [E, C, H]
+
+        def ffn_param(name, shape):
+            return self.param(
+                name, nn.initializers.normal(cfg.initializer_range), shape,
+                cfg.params_dtype,
+            ).astype(dtype)
+
+        w_gate = ffn_param("experts_gate", (E, H, I))
+        w_up = ffn_param("experts_up", (E, H, I))
+        w_down = ffn_param("experts_down", (E, I, H))
+
+        hmid = jnp.einsum("ech,ehi->eci", expert_in, w_gate)
+        act = {"silu": nn.silu, "gelu": nn.gelu}[cfg.activation]
+        hmid = act(hmid) * jnp.einsum("ech,ehi->eci", expert_in, w_up)
+        expert_out = jnp.einsum("eci,eih->ech", hmid, w_down)   # [E, C, H]
+
+        combine = dispatch * gate[:, None, None]                # [T, E, C]
+        out = jnp.einsum(
+            "tec,ech->th", combine.astype(dtype), expert_out
+        ).reshape(b, s, H)
+        out = nn.Dropout(rate=cfg.dropout)(out, deterministic=deterministic)
+        return out, aux.astype(jnp.float32)

@@ -41,7 +41,8 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 SEQUENCE_AXIS = "sequence"
 TENSOR_AXIS = "tensor"
-MESH_AXES = (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS)
+EXPERT_AXIS = "expert"
+MESH_AXES = (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, EXPERT_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,9 +56,10 @@ class MeshConfig:
     fsdp: int = 1
     sequence: int = 1
     tensor: int = 1
+    expert: int = 1
 
     def resolve(self, n_devices: int) -> tuple:
-        sizes = [self.data, self.fsdp, self.sequence, self.tensor]
+        sizes = [self.data, self.fsdp, self.sequence, self.tensor, self.expert]
         n_auto = sum(1 for s in sizes if s == -1)
         if n_auto > 1:
             raise ValueError("at most one mesh axis may be -1")

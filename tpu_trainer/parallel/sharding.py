@@ -46,7 +46,9 @@ from typing import Any, List, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+from tpu_trainer.parallel.mesh import (
+    DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, TENSOR_AXIS,
+)
 
 # Strategy names: ours (zero3/zero2/replicated) with the reference's
 # FSDP spellings accepted as aliases.
@@ -93,6 +95,21 @@ def _path_keys(path) -> Tuple[str, ...]:
     )
 
 
+# Expert-parallel placement: stacked expert FFN weights ([E, H, I] — or
+# [L, E, H, I] under the layer scan) shard their expert dim, which sits at
+# ndim-3. The router stays replicated (it is tiny).
+_EXPERT_PARAM_PREFIX = "experts_"
+
+
+def _expert_dim(path_keys: Tuple[str, ...], shape, expert_size: int) -> Optional[int]:
+    if expert_size <= 1 or not path_keys or len(shape) < 3:
+        return None
+    if not path_keys[-1].startswith(_EXPERT_PARAM_PREFIX):
+        return None
+    d = len(shape) - 3
+    return d if shape[d] % expert_size == 0 else None
+
+
 def _tensor_dim(path_keys: Tuple[str, ...], shape, tensor_size: int) -> Optional[int]:
     """Dim to shard over the tensor axis for this param path, or None."""
     if tensor_size <= 1:
@@ -114,13 +131,16 @@ def fsdp_spec(shape, fsdp_size: int) -> P:
 
 
 def _leaf_spec(path_keys, shape, *, fsdp_size: int, tensor_size: int,
-               shard_fsdp: bool) -> P:
-    """Combined TP + FSDP PartitionSpec for one array leaf."""
+               shard_fsdp: bool, expert_size: int = 1) -> P:
+    """Combined EP + TP + FSDP PartitionSpec for one array leaf."""
     if not shape:
         return P()
     dims: List[Optional[str]] = [None] * len(shape)
+    edim = _expert_dim(path_keys, shape, expert_size)
+    if edim is not None:
+        dims[edim] = EXPERT_AXIS
     tdim = _tensor_dim(path_keys, shape, tensor_size)
-    if tdim is not None:
+    if tdim is not None and dims[tdim] is None:
         dims[tdim] = TENSOR_AXIS
     if shard_fsdp and fsdp_size > 1:
         best: Optional[int] = None
@@ -138,10 +158,12 @@ def _leaf_spec(path_keys, shape, *, fsdp_size: int, tensor_size: int,
 def _specs_for_tree(tree: Any, mesh: Mesh, *, shard_fsdp: bool) -> Any:
     fsdp_size = mesh.shape[FSDP_AXIS]
     tensor_size = mesh.shape[TENSOR_AXIS]
+    expert_size = mesh.shape.get(EXPERT_AXIS, 1)
     return jax.tree_util.tree_map_with_path(
         lambda path, x: _leaf_spec(
             _path_keys(path), getattr(x, "shape", ()),
-            fsdp_size=fsdp_size, tensor_size=tensor_size, shard_fsdp=shard_fsdp,
+            fsdp_size=fsdp_size, tensor_size=tensor_size,
+            shard_fsdp=shard_fsdp, expert_size=expert_size,
         ),
         tree,
     )

@@ -113,6 +113,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--mesh_sequence", type=int, default=None,
                    help="ring-attention sequence-parallel axis size")
     p.add_argument("--mesh_tensor", type=int, default=None)
+    p.add_argument("--mesh_expert", type=int, default=None,
+                   help="expert-parallel axis size (MoE models)")
+    p.add_argument("--num_experts", type=int, default=None,
+                   help="> 0 turns every block's FFN into a routed MoE")
     p.add_argument("--multihost", action="store_true", default=None,
                    help="force jax.distributed.initialize() autodetect")
     p.add_argument("--device", type=str, default=None,
@@ -192,11 +196,16 @@ def resolve_configs(args, mode: str):
         ("dropout", "dropout"), ("attention_dropout", "attention_dropout"),
         ("use_flash_attention", "use_flash_attention"),
         ("gradient_checkpointing", "gradient_checkpointing"),
+        ("num_experts", "num_experts"),
+        ("expert_capacity_factor", "expert_capacity_factor"),
+        ("moe_aux_weight", "moe_aux_weight"),
     ]:
         if yaml_key in y_model:
             overrides[field] = y_model[yaml_key]
     if args.seq_len is not None:
         overrides["max_seq_len"] = args.seq_len
+    if args.num_experts is not None:
+        overrides["num_experts"] = args.num_experts
     if args.gradient_checkpointing:
         overrides["gradient_checkpointing"] = True
     if mode == "fsdp":
@@ -271,6 +280,7 @@ def resolve_configs(args, mode: str):
         fsdp=_pick(args.mesh_fsdp, default_mesh.fsdp),
         sequence=_pick(args.mesh_sequence, default_mesh.sequence),
         tensor=_pick(args.mesh_tensor, default_mesh.tensor),
+        expert=_pick(args.mesh_expert, 1),
     )
     parallel_config = ParallelConfig(
         mesh=mesh_config, sharding_strategy=strategy, cpu_offload=cpu_offload

@@ -129,6 +129,18 @@ class Trainer:
                 f"partition {n_proc} hosts; put sequence/tensor axes within "
                 f"a host, or grow data x fsdp to a multiple of the host count"
             )
+        self.ep_size = self.mesh.shape.get(mesh_lib.EXPERT_AXIS, 1)
+        if self.ep_size > 1:
+            if self.model_config.num_experts <= 0:
+                raise ValueError(
+                    "expert mesh axis > 1 requires a MoE model "
+                    "(GPTConfig.num_experts > 0)"
+                )
+            if self.model_config.num_experts % self.ep_size != 0:
+                raise ValueError(
+                    f"num_experts {self.model_config.num_experts} not "
+                    f"divisible by expert axis size {self.ep_size}"
+                )
         self.tp_size = self.mesh.shape[mesh_lib.TENSOR_AXIS]
         if self.tp_size > 1:
             if self.model_config.num_heads % self.tp_size != 0:
