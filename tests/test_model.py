@@ -193,6 +193,23 @@ class TestGPTForward:
         l2, loss2 = model_remat.apply({"params": params}, ids, labels=ids)
         np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-5)
 
+    def test_remat_lm_head_same_loss_and_gradients(self):
+        config = tiny_config()
+        config_remat = tiny_config(remat_lm_head=True)
+        model, params, ids = init_model(config)
+        model_remat = GPT(config_remat)
+
+        def loss_fn(m):
+            return lambda p: m.apply({"params": p}, ids, labels=ids)[1]
+
+        l1, g1 = jax.value_and_grad(loss_fn(model))(params)
+        l2, g2 = jax.value_and_grad(loss_fn(model_remat))(params)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+            g1, g2,
+        )
+
     @pytest.mark.parametrize("policy", ["full", "dots"])
     def test_remat_same_gradients(self, policy):
         config = tiny_config()

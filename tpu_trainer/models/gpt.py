@@ -317,11 +317,26 @@ class GPT(nn.Module):
         if labels is not None:
             # Shifted next-token cross entropy (reference gpt.py:450-453), mean
             # over batch * (seq - 1) positions, computed in float32.
-            shift_logits = logits[:, :-1, :]
-            shift_labels = labels[:, 1:]
-            loss = jnp.mean(
-                optax_softmax_cross_entropy(shift_logits, shift_labels)
-            )
+            if cfg.remat_lm_head:
+                # Nothing of the [b, s, vocab] softmax survives forward; the
+                # backward recomputes one vocab matmul instead of re-reading
+                # a ~bytes(b*s*V*4) buffer. (The unused `logits` above is
+                # dead-code-eliminated in the training graph, which only
+                # consumes the loss.)
+                def head_loss(xf):
+                    lg = embed.attend(xf).astype(jnp.float32)
+                    return jnp.mean(
+                        optax_softmax_cross_entropy(lg[:, :-1, :], labels[:, 1:])
+                    )
+
+                loss = jax.checkpoint(
+                    head_loss,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )(x)
+            else:
+                loss = jnp.mean(
+                    optax_softmax_cross_entropy(logits[:, :-1, :], labels[:, 1:])
+                )
             if cfg.num_experts > 0:
                 # MoE load-balance auxiliary (mean over layers).
                 loss = loss + cfg.moe_aux_weight * moe_aux / cfg.num_layers

@@ -199,6 +199,8 @@ def resolve_configs(args, mode: str):
         ("num_experts", "num_experts"),
         ("expert_capacity_factor", "expert_capacity_factor"),
         ("moe_aux_weight", "moe_aux_weight"),
+        ("remat_policy", "remat_policy"),
+        ("remat_lm_head", "remat_lm_head"),
     ]:
         if yaml_key in y_model:
             overrides[field] = y_model[yaml_key]
