@@ -127,6 +127,41 @@ class TestEndToEnd:
         assert "resumed" in out and "step 3" in out
         assert os.path.isdir(os.path.join(ckpt, "step_00000005"))
 
+    def test_text_dataset_map_and_streaming(self, tiny_yaml, tmp_path):
+        """Real-data path through the CLI: tinystories loader, map-style and
+        streaming. The model vocab covers every id either tokenizer (HF gpt2
+        if cached, byte fallback with eos=50256 otherwise) can produce, so
+        training runs on faithful, un-clamped labels."""
+        yaml_path = tmp_path / "tiny_fullvocab.yaml"
+        yaml_path.write_text(TINY_YAML.replace(
+            "vocab_size: 128", "vocab_size: 50304"
+        ))
+        corpus = tmp_path / "stories.txt"
+        corpus.write_text(
+            "\n".join(f"story {i} " + "once upon a time " * 8 for i in range(60))
+        )
+        for extra in ([], ["--streaming", "--cache_max_tokens", "10000"]):
+            ckpt = str(tmp_path / ("ck_txt" + ("_s" if extra else "")))
+            rc = run_training(
+                ["--config", str(yaml_path), "--checkpoint_dir", ckpt,
+                 "--dataset", "tinystories", "--data_path", str(corpus),
+                 "--max_steps", "3", "--eval_batches", "1"] + extra,
+                mode="ddp",
+            )
+            assert rc == 0
+            assert os.path.isdir(os.path.join(ckpt, "step_00000003"))
+
+    def test_too_small_dataset_fails_loudly(self, tiny_yaml, tmp_path):
+        corpus = tmp_path / "tiny.txt"
+        corpus.write_text("just one short line\n")
+        with pytest.raises((SystemExit, ValueError), match="tokens|batches"):
+            run_training(
+                ["--config", tiny_yaml, "--dataset", "tinystories",
+                 "--data_path", str(corpus),
+                 "--checkpoint_dir", str(tmp_path / "ck_small")],
+                mode="ddp",
+            )
+
     def test_fsdp_zero3_end_to_end(self, tiny_yaml, tmp_path):
         ckpt = str(tmp_path / "ck_fsdp")
         rc = run_training(
