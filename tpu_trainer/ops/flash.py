@@ -15,10 +15,14 @@ hierarchy):
   memory of the XLA fallback path.
 - Causality skips whole key blocks above the diagonal (the inner
   ``fori_loop`` upper bound is the diagonal block), halving the FLOPs.
-- Backward is the two-kernel split: a dq kernel (grid over query blocks,
-  streaming keys) and a dk/dv kernel (grid over key blocks, streaming
-  queries), using the saved per-row logsumexp and the precomputed
+- Backward is one fused kernel (grid over key blocks): a single
+  score/probability evaluation per block pair feeds dk, dv, and dq — dq
+  accumulates in f32 in a VMEM-resident full-row block across sequential
+  grid steps — using the saved per-row logsumexp and the precomputed
   ``delta = rowsum(dO * O)``.
+- Attention-weight dropout runs in-kernel from a counter-based hash mask
+  (regenerated bit-identically in the backward); RoPE optionally fuses in
+  (q/k rotate in VMEM against [seq, head_dim] tables).
 - All accumulation in float32 regardless of input dtype (bf16 in, bf16 out).
 
 The public API is BSHD ``[batch, seq, heads, head_dim]`` (the model's
