@@ -18,6 +18,7 @@ from tpu_trainer.models import (
     apply_rotary_pos_emb,
     count_parameters,
     generate,
+    generate_kv,
     rope_tables,
     rotate_half,
 )
@@ -254,6 +255,18 @@ class TestGenerate:
         assert out.shape == (2, 13)
         np.testing.assert_array_equal(out[:, :8], ids)
         assert (out >= 0).all() and (out < config.vocab_size).all()
+
+    def test_topk_zero_samples_full_distribution(self):
+        # top_k=0 disables the filter (reference gpt.py:476 only filters
+        # when top_k is truthy); sampling must still produce valid ids on
+        # both samplers.
+        config = tiny_config()
+        _, params, ids = init_model(config, batch=1, seq=4)
+        for fn in (generate, generate_kv):
+            out = fn(params, jax.random.PRNGKey(3), ids,
+                     config=config, max_new_tokens=4, top_k=0)
+            assert out.shape == (1, 8)
+            assert (out >= 0).all() and (out < config.vocab_size).all()
 
     def test_topk_one_is_greedy(self):
         config = tiny_config()
