@@ -459,6 +459,8 @@ def flash_attention(
     applying rope externally there.
     """
     b, s, h, d = q.shape
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
     # Largest block <= the requested size that divides the sequence, so e.g.
     # seq=768 runs the kernel with 256-blocks rather than falling back to
     # the O(seq^2) path.
@@ -473,7 +475,14 @@ def flash_attention(
             q, k = apply_rotary_pos_emb(q, k, rope[0], rope[1])
         if dropout_rate > 0.0:
             # The XLA fused path has no attention dropout; keep the
-            # configured semantics via the jnp reference path.
+            # configured semantics via the jnp reference path. That path is
+            # unconditionally causal — fail loudly rather than silently
+            # masking a non-causal caller.
+            if not causal:
+                raise NotImplementedError(
+                    "non-causal attention with dropout on a non-tiling "
+                    "sequence length has no kernel or fallback path"
+                )
             from tpu_trainer.ops.attention import reference_attention
 
             return reference_attention(
@@ -482,8 +491,6 @@ def flash_attention(
             )
         return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
     if dropout_rate > 0.0:
-        if dropout_rng is None:
-            raise ValueError("dropout_rate > 0 requires dropout_rng")
         if s >= 2**16:
             raise NotImplementedError(
                 "kernel dropout counters are uint32: seq must be < 65536"

@@ -74,7 +74,6 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int, scale: float):
     """Per-device body under shard_map. q, k, v: local ``[b, sl, h, d]``."""
     b, sl, h, d = q.shape
     idx = lax.axis_index(axis_name)
-    q32 = q.astype(jnp.float32) * scale
 
     rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
@@ -87,7 +86,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int, scale: float):
     def step(t, carry):
         m, l, acc, k_t, v_t = carry
         src = (idx - t) % sp  # global chunk id of the K/V currently held
-        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_t.astype(jnp.float32))
+        # Inputs stay in their storage dtype (bf16 x bf16 -> f32 runs at full
+        # MXU rate; f32 matmuls cost ~8x) with f32 accumulation — the same
+        # dtype discipline as the flash kernel (ops/flash.py).
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_t, preferred_element_type=jnp.float32
+        ) * scale
         # Global causal mask: query position idx*sl + r, key src*sl + c.
         allowed = (idx * sl + rows) >= (src * sl + cols)
         s = jnp.where(allowed[None, None], s, _NEG_INF)
@@ -95,7 +99,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, sp: int, scale: float):
         p = jnp.exp(s - m_new)                     # [b,h,q,k]; 0 where masked
         alpha = jnp.exp(m - m_new)                 # [b,h,q,1]
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        contrib = jnp.einsum("bhqk,bkhd->bqhd", p, v_t.astype(jnp.float32))
+        contrib = jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v_t.dtype), v_t,
+            preferred_element_type=jnp.float32,
+        )
         acc_new = acc * alpha[:, :, :, 0].transpose(0, 2, 1)[..., None] + contrib
         k_n, v_n = lax.ppermute((k_t, v_t), axis_name, perm=perm)
         return m_new, l_new, acc_new, k_n, v_n
