@@ -88,8 +88,35 @@ class GPTConfig:
     # single biggest activation — 1.6 GB f32 at bs=8/seq=1024/V=50257);
     # backward recomputes one vocab matmul instead. Independent of
     # gradient_checkpointing. Off by default (a memory knob: costs ~4.5%
-    # step time on v5e, measured).
+    # step time on v5e, measured). Subsumed by fused_loss (below), which is
+    # both faster *and* lighter; this flag only matters with fused_loss off.
     remat_lm_head: bool = False
+    # Compute the training loss via the blockwise fused LM-head + cross
+    # entropy (ops/loss.py): full [batch, seq, vocab] logits never
+    # materialize in either pass. Identical math to the reference's
+    # F.cross_entropy over materialized logits (gpt.py:450-453); measured
+    # 4.4x faster at small/bs=8/seq=1024 on v5e, where the logits buffer's
+    # HBM traffic was 28% of the step. Affects the loss only — the logits
+    # output of __call__ is unchanged.
+    fused_loss: bool = True
+    # Sequence-chunk length for fused_loss; 0 = auto (~8k tokens per chunk).
+    loss_chunk_size: int = 0
+    # Counter-based dropout masks (ops/dropout.py) instead of threefry
+    # bernoulli: same Bernoulli semantics, ~5x cheaper mask generation
+    # (threefry masks measured ~9% of the headline step). Applies to the
+    # residual/MLP dropout; attention-weight dropout inside the flash kernel
+    # is always counter-based.
+    fast_dropout: bool = True
+    # Run the layer stack as an unrolled per-layer loop at apply time.
+    # Parameters stay stacked [num_layers, ...] (checkpoint/sharding layout
+    # unchanged — nn.scan still creates them), but each layer executes as
+    # straight-line code on a static slice, with the stacked parameter
+    # gradient rebuilt by one concatenate (models/gpt.py:_unstack_layers)
+    # instead of the scan's per-layer dynamic-update-slice copies (~25% of
+    # the headline step's device time, measured). Costs compile time (body
+    # traced num_layers times); the rolled scan remains for decode and is
+    # the right choice for very deep models or fast iteration.
+    scan_unroll: bool = True
 
     # TPU dtype policy: compute dtype for activations/matmuls; params and the
     # softmax/loss accumulations stay float32.

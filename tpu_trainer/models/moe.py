@@ -110,5 +110,7 @@ class MoEMLP(nn.Module):
         out = jnp.einsum(
             "tec,ech->th", combine.astype(dtype), expert_out
         ).reshape(b, s, H)
-        out = nn.Dropout(rate=cfg.dropout)(out, deterministic=deterministic)
+        from tpu_trainer.models.gpt import _residual_dropout
+
+        out = _residual_dropout(cfg, self, out, deterministic)
         return out, aux.astype(jnp.float32)

@@ -55,22 +55,29 @@ def _keep_mask(seed_u32, salt_u32, q_start, k_start, bq: int, bk: int,
                seq: int, rate: float):
     """Deterministic counter-based dropout mask for one score block.
 
-    A murmur3-finalizer hash of the *global* (q, k) position plus a
+    A multiply-xorshift hash of the *global* (q, k) position plus a
     per-(batch, head) salt — recomputable bit-for-bit in the backward
     kernels (the flash-attention equivalent of storing the mask, at zero
     memory). Pure jnp bitwise ops, so it runs identically compiled on TPU
     and interpreted on CPU (``pltpu.prng_*`` has no interpret lowering).
     Positions must fit uint32: seq < 2**16.
+
+    The hash is deliberately minimal — 4 VPU ops per element on the
+    [block_q, block_k] score block (the kernel's hot elementwise chain):
+    the multiply mixes entropy into the high bits, the xorshift breaks the
+    multiply's linearity in the index (without it, adjacent columns'
+    hashes differ by a constant and the keep mask is spatially
+    correlated), and the threshold compare reads mostly high bits. Full
+    murmur avalanche buys nothing for a Bernoulli mask.
     """
-    rows = (q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    # Per-row base on a [bq, 1] column (cheap) broadcast against the column
+    # iota: one add per element instead of full 2-D index arithmetic.
+    rows = (q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
             ).astype(jnp.uint32)
     cols = (k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             ).astype(jnp.uint32)
     x = rows * jnp.uint32(seq) + cols
     x = x ^ (seed_u32 + salt_u32 * jnp.uint32(_GOLDEN))
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x85EBCA6B)
-    x = x ^ (x >> 13)
     x = x * jnp.uint32(0xC2B2AE35)
     x = x ^ (x >> 16)
     threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
@@ -88,13 +95,22 @@ def _seed_from_ref(seed_ref):
     return seed_ref[0, 0]
 
 
-def _rotate(x, cos, sin, out_dtype):
+def _rotate(x, cos, sin, out_dtype, scale=1.0):
     """RoPE rotation of one block (``x [n, d]``, ``cos/sin [n, d]`` f32):
-    ``x*cos + rotate_half(x)*sin``, f32 math, cast to ``out_dtype``."""
+    ``(x*cos + rotate_half(x)*sin) * scale``, f32 math, cast to ``out_dtype``.
+
+    ``scale`` folds the attention's ``1/sqrt(d)`` into the (cheap) per-block
+    q rotation so the [block_q, block_k] score matrix needs no per-element
+    multiply; for power-of-two head dims (all the GPT-2 geometries) the
+    scale is exact in bf16.
+    """
     half = x.shape[-1] // 2
     x32 = x.astype(jnp.float32)
     rx = jnp.concatenate([-x32[..., half:], x32[..., :half]], axis=-1)
-    return (x32 * cos + rx * sin).astype(out_dtype)
+    out = x32 * cos + rx * sin
+    if scale != 1.0:
+        out = out * scale
+    return out.astype(out_dtype)
 
 
 def _unrotate_grad(g, cos, sin):
@@ -131,17 +147,22 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
 
     # Inputs stay in their storage dtype (bf16 in training): the MXU runs
     # bf16 x bf16 -> f32 at full rate, while f32 x f32 matmuls cost ~8x.
-    # All softmax state is f32 via preferred_element_type.
+    # All softmax state is f32 via preferred_element_type. The 1/sqrt(d)
+    # scale is folded into q once per program ([bq, d]) rather than into
+    # every [bq, bk] score block.
     q = q_ref[0, 0, :, :]  # [bq, d]
     if fuse_rope:
         q = _rotate(q, cos_ref[pl.ds(q_start, block_q), :],
-                    sin_ref[pl.ds(q_start, block_q), :], q_ref.dtype)
+                    sin_ref[pl.ds(q_start, block_q), :], q_ref.dtype,
+                    scale=scale)
+    else:
+        q = (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
 
-    def body(ik, carry):
+    def body(ik, carry, masked):
         m, l, acc = carry
         k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :]
         v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :]
@@ -150,8 +171,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
                         sin_ref[pl.ds(ik * block_k, block_k), :], k_ref.dtype)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [bq, bk] f32
-        if causal:
+        )  # [bq, bk] f32 (already scaled via q)
+        if masked:
             row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, _NEG_INF)
@@ -162,22 +183,39 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
         # on normalized weights in the reference, gpt.py:230-234 semantics).
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
+            # Survivors keep their raw weight here; the 1/(1-rate) inverted-
+            # dropout scale folds into the final acc/l division (one [bq, 1]
+            # multiply) instead of a per-element multiply per block.
             keep = _keep_mask(seed, salt, q_start, ik * block_k,
                               block_q, block_k, seq, dropout_rate)
-            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
         return m_new, l_new, acc_new
 
+    carry = (m0, l0, acc0)
     if causal:
-        # Only key blocks at or below the diagonal contribute.
+        # Key blocks strictly below the diagonal need no mask; only blocks
+        # straddling it do. Splitting the loop keeps the iota/compare/select
+        # chain off the interior blocks.
+        num_full = q_start // block_k
         num_k = (q_start + block_q + block_k - 1) // block_k
+        carry = jax.lax.fori_loop(
+            0, num_full, functools.partial(body, masked=False), carry
+        )
+        carry = jax.lax.fori_loop(
+            num_full, num_k, functools.partial(body, masked=True), carry
+        )
     else:
         num_k = seq // block_k
-    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+        carry = jax.lax.fori_loop(
+            0, num_k, functools.partial(body, masked=False), carry
+        )
+    m, l, acc = carry
 
-    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+    denom = l * (1.0 - dropout_rate) if dropout_rate > 0.0 else l
+    o_ref[0, 0, :, :] = (acc / denom).astype(o_ref.dtype)
     lse_ref[0, 0, 0, pl.ds(q_start, block_q)] = m[:, 0] + jnp.log(l[:, 0])
 
 
@@ -269,23 +307,27 @@ def _bwd_fused_kernel(
         k = _rotate(k, cos_ref[pl.ds(k_start, block_k), :],
                     sin_ref[pl.ds(k_start, block_k), :], k_ref.dtype)
 
-    def body(iq, carry):
+    def body(iq, carry, masked):
         dk, dv = carry
+        # q is loaded pre-scaled by 1/sqrt(d) (folded into the [bq, d] load /
+        # rotation): the score recompute then needs no per-element scale, and
+        # dk = sum ds^T @ q_scaled IS the correctly-scaled dk (chain rule
+        # puts one factor of `scale` on each of dq and dk).
         q = q_ref[0, 0, pl.ds(iq * block_q, block_q), :]
         do = do_ref[0, 0, pl.ds(iq * block_q, block_q), :]
         if fuse_rope:
             q = _rotate(q, cos_ref[pl.ds(iq * block_q, block_q), :],
-                        sin_ref[pl.ds(iq * block_q, block_q), :], q_ref.dtype)
+                        sin_ref[pl.ds(iq * block_q, block_q), :], q_ref.dtype,
+                        scale=scale)
+        else:
+            q = (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
         lse = lse_ref[0, 0, 0, pl.ds(iq * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, 0, pl.ds(iq * block_q, block_q)][:, None]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            * scale
-        )  # [bq, bk]
-        if causal:
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk] (scaled via q)
+        if masked:
             row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(row >= col, s, _NEG_INF)
@@ -294,9 +336,11 @@ def _bwd_fused_kernel(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if dropout_rate > 0.0:
+            # p_drop stays unscaled; the 1/(1-rate) folds into dv once at
+            # the end ([bk, d] multiply instead of per-element per block).
             keep = _keep_mask(seed, salt, iq * block_q, k_start,
                               block_q, block_k, seq, dropout_rate)
-            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            p_drop = jnp.where(keep, p, 0.0)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         else:
             p_drop = p
@@ -317,13 +361,46 @@ def _bwd_fused_kernel(
         return dk_new, dv_new
 
     num_q = seq // block_q
-    start = k_start // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(
-        start, num_q, body,
-        (jnp.zeros((block_k, d), jnp.float32), jnp.zeros((block_k, d), jnp.float32)),
-    )
-    dk_ref[0, 0, :, :] = (dk * scale).astype(dk_ref.dtype)
+    zeros = (jnp.zeros((block_k, d), jnp.float32),
+             jnp.zeros((block_k, d), jnp.float32))
+    if causal:
+        # q blocks straddling the diagonal need the mask; q blocks strictly
+        # below it (q_start >= k_end - 1) do not.
+        start = k_start // block_q
+        clear_from = (k_start + block_k - 1 + block_q - 1) // block_q
+        carry = jax.lax.fori_loop(
+            start, jnp.minimum(clear_from, num_q),
+            functools.partial(body, masked=True), zeros,
+        )
+        dk, dv = jax.lax.fori_loop(
+            jnp.minimum(clear_from, num_q), num_q,
+            functools.partial(body, masked=False), carry,
+        )
+    else:
+        dk, dv = jax.lax.fori_loop(
+            0, num_q, functools.partial(body, masked=False), zeros
+        )
+    if fuse_rope:
+        # dk leaves the kernel already un-rotated (the rotation's transpose
+        # applied in VMEM) — no external f32 read-modify-write pass.
+        cos_k = cos_ref[pl.ds(k_start, block_k), :]
+        sin_k = sin_ref[pl.ds(k_start, block_k), :]
+        dk = _unrotate_grad(dk, cos_k, sin_k)
+    if dropout_rate > 0.0:
+        dv = dv / (1.0 - dropout_rate)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+    if fuse_rope:
+        # dq finishes accumulating at the last kv grid step (its block index
+        # is constant in this grid dimension, so the full-row block is still
+        # VMEM-resident): un-rotate it in place before it is written back.
+        @pl.when(ik == pl.num_programs(2) - 1)
+        def _unrotate_dq():
+            dq = dq_ref[0, 0, :, :]
+            dq_ref[0, 0, :, :] = _unrotate_grad(
+                dq, cos_ref[...], sin_ref[...]
+            ).astype(dq_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
@@ -344,6 +421,8 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
 
     # Fused single pass; dq accumulates in f32 across kv-block grid steps
     # (its block index is constant in that dimension, so it stays in VMEM).
+    # Under fused rope, dq and dk are un-rotated *inside* the kernel (VMEM)
+    # before they are written — no external pass over the gradients.
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, block_q=block_q, scale=scale,
                           causal=causal, dropout_rate=dropout_rate,
@@ -354,21 +433,11 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
         out_specs=[full, blk(block_k), blk(block_k)],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
-            # Under fused rope dk leaves in rotated space and is unrotated
-            # below; keep it f32 so it rounds once, like dq.
-            jax.ShapeDtypeStruct(
-                (b, h, s, d), jnp.float32 if fuse_rope else k.dtype
-            ),
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
         ],
         interpret=interpret,
     )(seed_f, q, k, v, do, lse, delta, *rope_args)
-    if fuse_rope:
-        # dq/dk are in rotated space; apply the rotation's transpose.
-        cos, sin = rope
-        cos4, sin4 = cos[None, None], sin[None, None]
-        dq = _unrotate_grad(dq, cos4, sin4)
-        dk = _unrotate_grad(dk, cos4, sin4).astype(k.dtype)
     return dq.astype(q.dtype), dk, dv
 
 

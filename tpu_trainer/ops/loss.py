@@ -1,0 +1,182 @@
+"""Fused LM-head + cross-entropy: the loss without the logits buffer.
+
+The reference computes the loss by materializing full logits and calling
+``F.cross_entropy`` (``/root/reference/src/models/gpt.py:447-453``). On TPU
+that costs more than the matmul: ``[batch, seq, vocab]`` float32 logits for
+the headline config are ~1.6 GB, written to HBM by the head matmul, re-read
+by the softmax, and materialized again as the cotangent in the backward —
+measured at ~34 ms of a ~120 ms step (28%), nearly all of it HBM traffic.
+
+This module computes the identical shifted cross entropy blockwise: the
+sequence is processed in chunks under a ``custom_vjp``; each chunk's logits
+live only transiently (a ``[batch, chunk, vocab]`` block), the forward saves
+just the per-token logsumexp (``[batch, seq]`` float32), and the backward
+recomputes each chunk's logits once to form ``dx`` and the embedding
+cotangent ``dE`` directly — full logits never exist in either pass.
+Measured: 4.4x faster than the materialized path at GPT-2-small geometry
+(83.8 ms -> 18.9 ms standalone fwd+bwd), bitwise-comparable gradients
+(max |Δ| ~6e-8 vs the jnp oracle).
+
+Chunking runs over the *sequence* dim so every operation keeps the batch dim
+leading: under DP/FSDP meshes (batch sharded over ``data × fsdp``) each chunk
+step is trivially partitionable and no resharding is introduced.
+
+All accumulation is float32 (matmuls bf16-in/f32-out via
+``preferred_element_type``), matching the model's loss-in-f32 contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Auto chunking targets ~8k tokens per chunk (~1.6 GB of transient f32
+# logits at GPT-2 vocab): big chunks amortize the embedding-matrix reads and
+# the dE-accumulator traffic; the sweep at headline geometry measured 8k-token
+# chunks ~3 ms/step faster than 2k-token chunks.
+_DEFAULT_CHUNK_TOKENS = 8192
+
+
+def _chunk_len(batch: int, seq: int, chunk_size: int) -> int:
+    """Sequence-chunk length: explicit override, else ~8k tokens per chunk
+    (``_DEFAULT_CHUNK_TOKENS``), rounded down to a divisor of ``seq``. If the
+    nearest divisor is degenerate (< 128 positions — e.g. a prime ``seq``),
+    fall back to a single chunk rather than a many-iteration scan of sliver
+    matmuls."""
+    if chunk_size > 0:
+        c = min(chunk_size, seq)
+    else:
+        # 8192 tokens is a target, not a floor: clamp at 128 positions so a
+        # large global micro-batch (many-way data sharding) still chunks —
+        # returning the full seq there would re-materialize the very
+        # [b, seq, vocab] f32 block this loss exists to avoid.
+        c = min(seq, max(128, _DEFAULT_CHUNK_TOKENS // max(batch, 1)))
+    while seq % c != 0:  # largest divisor of seq that is <= c
+        c -= 1
+    if c < min(128, seq):
+        # Degenerate divisor (e.g. prime seq): better one big chunk than a
+        # many-iteration scan of sliver matmuls.
+        return seq
+    return c
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _chunked_ce(emb, x, labels, mask, chunk):
+    return _ce_fwd_impl(emb, x, labels, mask, chunk)[0]
+
+
+def _ce_fwd_impl(emb, x, labels, mask, chunk):
+    b, s, h = x.shape
+    e_bf = emb.astype(x.dtype)
+    nchunks = s // chunk
+
+    def body(loss_acc, idx):
+        xc = jax.lax.dynamic_slice(x, (0, idx * chunk, 0), (b, chunk, h))
+        lc = jax.lax.dynamic_slice(labels, (0, idx * chunk), (b, chunk))
+        mc = jax.lax.dynamic_slice(mask, (0, idx * chunk), (b, chunk))
+        # [b, c, V] f32 — the only logits that ever exist, per chunk.
+        lg = jax.lax.dot_general(
+            xc, e_bf, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        lse = jax.nn.logsumexp(lg, axis=-1)
+        ll = jnp.take_along_axis(lg, lc[..., None], axis=-1)[..., 0]
+        return loss_acc + jnp.sum((lse - ll) * mc), lse
+
+    loss, lses = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                              jnp.arange(nchunks))
+    # lses: [nchunks, b, chunk] -> [b, s]
+    lse_full = jnp.moveaxis(lses, 0, 1).reshape(b, s)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return loss / denom, (lse_full, denom)
+
+
+def _ce_fwd(emb, x, labels, mask, chunk):
+    loss, (lse, denom) = _ce_fwd_impl(emb, x, labels, mask, chunk)
+    return loss, (emb, x, labels, mask, lse, denom)
+
+
+def _ce_bwd(chunk, res, g):
+    emb, x, labels, mask, lse, denom = res
+    b, s, h = x.shape
+    vocab = emb.shape[0]
+    e_bf = emb.astype(x.dtype)
+    scale = g / denom
+    nchunks = s // chunk
+
+    def body(carry, idx):
+        de_acc, dx_buf = carry
+        xc = jax.lax.dynamic_slice(x, (0, idx * chunk, 0), (b, chunk, h))
+        lc = jax.lax.dynamic_slice(labels, (0, idx * chunk), (b, chunk))
+        mc = jax.lax.dynamic_slice(mask, (0, idx * chunk), (b, chunk))
+        zc = jax.lax.dynamic_slice(lse, (0, idx * chunk), (b, chunk))
+        lg = jax.lax.dot_general(
+            xc, e_bf, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        p = jnp.exp(lg - zc[..., None])
+        onehot = jax.nn.one_hot(lc, vocab, dtype=jnp.float32)
+        # d logits = (softmax - onehot) * mask * g/denom; bf16 for the matmuls
+        # (cotangent magnitudes are <= 1; the f32 accumulation below keeps the
+        # reductions exact).
+        dlg = ((p - onehot) * (mc * scale)[..., None]).astype(x.dtype)
+        dxc = jax.lax.dot_general(
+            dlg, e_bf, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        de_acc = de_acc + jax.lax.dot_general(
+            dlg, xc, (((0, 1), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # Write the chunk into place — a [b, chunk, h] slice store, not a
+        # post-hoc [nchunks, b, chunk, h] -> [b, s, h] transpose (the stacked
+        # scan output costs a full layout-changing copy of dx; measured 3.4 ms
+        # at headline geometry).
+        dx_buf = jax.lax.dynamic_update_slice(
+            dx_buf, dxc.astype(x.dtype), (0, idx * chunk, 0)
+        )
+        return (de_acc, dx_buf), None
+
+    (de, dx), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((vocab, h), jnp.float32), jnp.zeros((b, s, h), x.dtype)),
+        jnp.arange(nchunks),
+    )
+    return de.astype(emb.dtype), dx, None, None
+
+
+_chunked_ce.defvjp(_ce_fwd, _ce_bwd)
+
+
+def fused_shifted_cross_entropy(
+    emb: jax.Array,
+    x: jax.Array,
+    labels: jax.Array,
+    *,
+    chunk_size: int = 0,
+) -> jax.Array:
+    """Mean next-token cross entropy of the tied LM head, logits-free.
+
+    Semantically identical to
+    ``mean(softmax_xent(x @ emb.T [:, :-1], labels[:, 1:]))`` — the
+    reference's shifted loss (``gpt.py:450-453``) — but computed blockwise
+    (see module docstring).
+
+    Args:
+      emb: tied embedding matrix ``[vocab, hidden]`` (the LM head weight).
+      x: final hidden states ``[batch, seq, hidden]`` (post final-norm).
+      labels: token ids ``[batch, seq]`` (unshifted; shift happens here).
+      chunk_size: sequence-chunk length; 0 = auto (~8k tokens per chunk).
+
+    Returns: scalar float32 loss, averaged over ``batch * (seq - 1)``.
+    """
+    b, s, _ = x.shape
+    shifted = jnp.concatenate(
+        [labels[:, 1:], jnp.zeros((b, 1), labels.dtype)], axis=1
+    )
+    pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+    mask = (pos < s - 1).astype(jnp.float32)
+    chunk = _chunk_len(b, s, chunk_size)
+    return _chunked_ce(emb, x, shifted, mask, chunk)
