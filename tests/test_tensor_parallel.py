@@ -106,6 +106,33 @@ class TestTensorParallelTraining:
         assert ddp == pytest.approx(tp_zero3, rel=1e-5)
         assert ddp == pytest.approx(tp_sp, rel=1e-5)
 
+    def test_tp_flash_kernel_losses_match_ddp(self, monkeypatch):
+        """The Pallas kernel under a tensor axis: shard_mapped over heads by
+        the attention dispatch (trainer.py's TP force-off is gone), run in
+        interpret mode on the fake mesh. seq=128 so the kernel tiles."""
+        monkeypatch.setenv("TPU_TRAINER_FLASH_INTERPRET", "1")
+        flash_cfg = dataclasses.replace(
+            TINY, use_flash_attention=True, max_seq_len=128
+        )
+        batch = np.random.default_rng(0).integers(0, 128, (8, 128), np.int32)
+
+        def run(mesh_cfg, batch_size):
+            cfg = TrainingConfig(
+                batch_size=batch_size, max_seq_len=128,
+                gradient_accumulation_steps=1, mixed_precision="fp32",
+                warmup_steps=2, max_steps=10,
+            )
+            trainer = Trainer(flash_cfg, cfg, ParallelConfig(mesh_cfg))
+            assert trainer.model_config.use_flash_attention  # no force-off
+            state = trainer.init_state(seed=0)
+            for _ in range(2):
+                state, metrics = trainer.train_step(state, batch)
+            return float(metrics["loss"])
+
+        ddp = run(MeshConfig(data=-1, fsdp=1), 1)
+        tp4 = run(MeshConfig(data=2, fsdp=1, tensor=4), 4)
+        assert ddp == pytest.approx(tp4, rel=1e-5)
+
     def test_tp_rejects_indivisible_heads(self):
         cfg = dataclasses.replace(TINY, num_heads=2)  # 2 % 4 != 0
         with pytest.raises(ValueError, match="num_heads"):

@@ -19,15 +19,125 @@ does for torch's BHSD convention).
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+# Test hook: route the dispatch to the Pallas kernel in interpret mode even
+# off-TPU, so the fake-8-device CPU mesh tests exercise the kernel (and its
+# shard_map wrapping) end to end. Set TPU_TRAINER_FLASH_INTERPRET=1.
+_INTERPRET_ENV = "TPU_TRAINER_FLASH_INTERPRET"
+
 
 def causal_mask(seq_len: int) -> jax.Array:
     """Boolean [seq, seq] mask, True where attention is allowed (lower tri)."""
     return jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
+
+
+def _flash_mesh(q: jax.Array):
+    """The active mesh context's mesh, when sharding the kernel is useful.
+
+    Returns None (plain kernel call — GSPMD sees one device, nothing to
+    partition) unless a mesh with a non-trivial ``data``/``fsdp``/``tensor``
+    axis is published by the trainer (``parallel/context.py``). Attention is
+    independent across batch and heads, so those axes shard the kernel
+    losslessly; the ``sequence`` axis is the ring path's job and never
+    reaches this dispatch (the model routes SP through ``ops/ring.py``).
+    """
+    from tpu_trainer.parallel.context import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+
+    sizes = [
+        mesh.shape.get(DATA_AXIS, 1),
+        mesh.shape.get(FSDP_AXIS, 1),
+        mesh.shape.get(TENSOR_AXIS, 1),
+    ]
+    if all(s == 1 for s in sizes):
+        return None
+    return mesh
+
+
+def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
+    """Run the Pallas kernel under ``shard_map`` over batch/head mesh axes.
+
+    A ``pallas_call`` is opaque to the SPMD partitioner: left inside a GSPMD
+    region on a multi-device mesh it forces replication (all-gather of
+    q/k/v). Wrapping it in ``shard_map`` over the axes attention is
+    independent along — batch over ``data`` x ``fsdp``, heads over
+    ``tensor`` — runs the unchanged kernel on each shard with zero
+    communication. Axes that don't divide the dim (tiny test batches) stay
+    replicated, mirroring ``ring_attention``'s spec fallback.
+
+    In-kernel dropout stays decorrelated across shards by folding each
+    shard's mesh coordinates into the PRNG key (the kernel's counter-based
+    mask hashes *local* positions, which coincide across shards).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+    from tpu_trainer.ops import flash
+
+    b, _, h, _ = q.shape
+    dp = mesh.shape.get(DATA_AXIS, 1) * mesh.shape.get(FSDP_AXIS, 1)
+    b_spec = (DATA_AXIS, FSDP_AXIS) if (dp > 1 and b % dp == 0) else None
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    h_spec = TENSOR_AXIS if (tp > 1 and h % tp == 0) else None
+    if b_spec is None and h_spec is None:
+        return flash.flash_attention(q, k, v, **kernel_kwargs)
+    spec = P(b_spec, None, h_spec, None)
+
+    # Traced values (rng key, rope tables) enter shard_map as explicit
+    # replicated arguments, not closure captures.
+    static_kwargs = dict(kernel_kwargs)
+    rng = static_kwargs.pop("dropout_rng")
+    rope_tabs = static_kwargs.pop("rope")
+    has_rng = rng is not None
+    has_rope = rope_tabs is not None
+    extras = (() if not has_rng else (rng,)) + (
+        tuple(rope_tabs) if has_rope else ()
+    )
+    extra_specs = (() if not has_rng else (P(),)) + (
+        (P(None, None), P(None, None)) if has_rope else ()
+    )
+
+    def local(q, k, v, *extra):
+        i = 0
+        rng_local = None
+        if has_rng:
+            # Decorrelate the in-kernel dropout mask across shards — but only
+            # along axes that actually shard the inputs: folding a replicated
+            # axis's coordinate in would make devices along it compute
+            # *different* outputs for identical data, breaking the replicated
+            # out_spec.
+            coord = jax.lax.axis_index(TENSOR_AXIS) if h_spec else 0
+            if b_spec is not None:
+                coord = coord * dp + jax.lax.axis_index(
+                    DATA_AXIS
+                ) * mesh.shape.get(FSDP_AXIS, 1) + jax.lax.axis_index(
+                    FSDP_AXIS
+                )
+            rng_local = jax.random.fold_in(extra[0], coord)
+            i = 1
+        rope_local = (extra[i], extra[i + 1]) if has_rope else None
+        return flash.flash_attention(
+            q, k, v, dropout_rng=rng_local, rope=rope_local, **static_kwargs
+        )
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec) + extra_specs,
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, *extras)
 
 
 def reference_attention(
@@ -76,19 +186,25 @@ def flash_attention(
     reference's manual branch).
     """
     active_dropout = dropout_rate > 0.0 and not deterministic
+    interpret = os.environ.get(_INTERPRET_ENV, "0") == "1"
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    if on_tpu:
+    if on_tpu or interpret:
         try:
             from tpu_trainer.ops import flash
         except ImportError:
             flash = None  # degrade to the XLA/manual paths below
         if flash is not None:
-            return flash.flash_attention(
-                q, k, v, causal=True,
+            kernel_kwargs = dict(
+                causal=True,
                 dropout_rate=dropout_rate if active_dropout else 0.0,
                 dropout_rng=dropout_rng,
                 rope=rope,
+                interpret=interpret,
             )
+            mesh = _flash_mesh(q)
+            if mesh is not None:
+                return _sharded_kernel(q, k, v, mesh, kernel_kwargs)
+            return flash.flash_attention(q, k, v, **kernel_kwargs)
     if rope is not None:
         from tpu_trainer.ops.rope import apply_rotary_pos_emb
 

@@ -1,0 +1,49 @@
+"""Trace-time mesh context.
+
+The model is parallelism-blind (the reference's load-bearing property,
+SURVEY.md §1): it never receives a mesh. Most ops need none — GSPMD
+partitions plain jnp from the in/out shardings alone. The exception is the
+Pallas flash kernel: a ``pallas_call`` is opaque to the SPMD partitioner, so
+without help XLA replicates it (all-gathering q/k/v to every device — the
+"replication cliff" on a DP/FSDP/TP mesh).
+
+The trainer publishes its mesh here while tracing the step; the attention
+dispatch (``ops/attention.py``) reads it and wraps the kernel in a
+``shard_map`` over the batch (``data`` x ``fsdp``) and heads (``tensor``)
+axes — attention is independent along both, so the kernel runs unchanged on
+each shard. The same pattern as ``ops/ring.py``'s sequence-parallel context,
+for the non-sequence axes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Optional
+
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshContext:
+    mesh: Mesh
+
+
+_ACTIVE: Optional[MeshContext] = None
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    """While active (static, trace-time), mesh-aware ops may shard_map
+    themselves over ``mesh`` instead of appearing opaque to GSPMD."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = MeshContext(mesh)
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _ACTIVE.mesh if _ACTIVE is not None else None

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.models.gpt import GPT
 from tpu_trainer.ops import ring
+from tpu_trainer.parallel import context as ctx_lib
 from tpu_trainer.parallel import mesh as mesh_lib
 from tpu_trainer.parallel import sharding as shard_lib
 from tpu_trainer.training.config import TrainingConfig
@@ -147,12 +148,6 @@ class Trainer:
                 raise ValueError(
                     f"num_heads {self.model_config.num_heads} not divisible "
                     f"by tensor axis size {self.tp_size}"
-                )
-            if self.model_config.use_flash_attention:
-                # The Pallas kernel is not GSPMD-partitionable yet; under TP
-                # it would force replicated attention. Use the XLA path.
-                self.model_config = dataclasses.replace(
-                    self.model_config, use_flash_attention=False
                 )
         self.model = GPT(self.model_config)
         self.optimizer = make_optimizer(training_config)
@@ -336,13 +331,17 @@ class Trainer:
         return loss
 
     def _sp_context(self):
-        """Sequence-parallel (ring attention) trace context, when the mesh has
-        a non-trivial ``sequence`` axis."""
-        if self.sp_size > 1:
-            return ring.sequence_parallel(self.mesh)
+        """Trace context for the model body: publishes the mesh so mesh-aware
+        ops (the Pallas flash kernel) shard_map themselves over it
+        (``parallel/context.py``), plus the ring-attention context when the
+        mesh has a non-trivial ``sequence`` axis."""
         import contextlib
 
-        return contextlib.nullcontext()
+        stack = contextlib.ExitStack()
+        stack.enter_context(ctx_lib.mesh_scope(self.mesh))
+        if self.sp_size > 1:
+            stack.enter_context(ring.sequence_parallel(self.mesh))
+        return stack
 
     def _train_step(self, state: TrainState, batch: jax.Array):
         cfg = self.training_config

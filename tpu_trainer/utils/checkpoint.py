@@ -100,6 +100,7 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
     """Restore a TrainState onto the trainer's mesh/sharding (resharding as
     needed) plus the saved metadata. ``trainer`` is a
     ``tpu_trainer.training.trainer.Trainer``."""
+    path = os.path.abspath(path)  # orbax requires absolute paths
     meta = load_meta(path)
     shapes = jax.eval_shape(trainer._make_state, jax.random.PRNGKey(0))
     abstract = jax.tree_util.tree_map(
@@ -117,6 +118,7 @@ def restore_params(path: str):
     trainer from the checkpoint's own meta.json and restores onto the default
     devices) or a consolidated ``.msgpack`` file. Returns ``(params, config)``.
     """
+    path = os.path.abspath(path)  # orbax requires absolute paths
     if os.path.isfile(path):  # consolidated export
         import flax.serialization as ser
 
