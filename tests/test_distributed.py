@@ -118,10 +118,16 @@ class TestFlashKernelUnderMesh:
             losses.append(float(m["loss"]))
         return losses
 
-    @pytest.fixture(scope="function")
+    _single_cache = None  # computed once; the run is deterministic
+
+    @pytest.fixture
     def single_flash(self):
-        return self.run_flash(MeshConfig(data=1, fsdp=1), "replicated",
-                              batch_size=8)
+        cls = TestFlashKernelUnderMesh
+        if cls._single_cache is None:
+            cls._single_cache = self.run_flash(
+                MeshConfig(data=1, fsdp=1), "replicated", batch_size=8
+            )
+        return cls._single_cache
 
     def test_dp8_flash_equals_single(self, single_flash):
         losses = self.run_flash(MeshConfig(data=8, fsdp=1), "replicated",

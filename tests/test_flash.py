@@ -156,6 +156,37 @@ class TestKernelDropout:
         with pytest.raises(ValueError, match="dropout_rng"):
             flash_attention(q, k, v, interpret=True, dropout_rate=0.1)
 
+    def test_lse_gradient_with_dropout(self):
+        # The return_lse backward with dropout active: the lse cotangent
+        # folds into the delta row while dp/p_drop are masked, and the dlse
+        # term must multiply the *undropped* p (ds = p*(dp_drop - delta +
+        # dlse)). Finite differences through a loss touching both outputs
+        # guard that coupling.
+        q, k, v = _rand_qkv(jax.random.PRNGKey(17), 1, 128, 1, 16)
+        rng = jax.random.PRNGKey(9)
+        probe_o = jax.random.normal(jax.random.PRNGKey(18), q.shape)
+        probe_l = jax.random.normal(jax.random.PRNGKey(19), (1, 1, 128))
+
+        def f(qq, kk):
+            o, lse = flash_attention(
+                qq, kk, v, interpret=True, dropout_rate=0.25,
+                dropout_rng=rng, return_lse=True,
+            )
+            return jnp.sum(o * probe_o) + jnp.sum(jnp.sin(lse) * probe_l)
+
+        gq, gk = jax.grad(f, argnums=(0, 1))(q, k)
+        eps = 1e-3
+        for arg, g, name in ((q, gq, "dq"), (k, gk, "dk")):
+            direction = jax.random.normal(jax.random.PRNGKey(20), arg.shape)
+            if name == "dq":
+                fd = (f(q + eps * direction, k) - f(q - eps * direction, k)) / (2 * eps)
+            else:
+                fd = (f(q, k + eps * direction) - f(q, k - eps * direction)) / (2 * eps)
+            analytic = jnp.sum(g * direction)
+            np.testing.assert_allclose(
+                fd, analytic, rtol=2e-2, atol=2e-2, err_msg=name
+            )
+
 
 class TestFusedRope:
     """RoPE fused into the kernel vs external rotation + reference path."""

@@ -85,6 +85,68 @@ class TestRingNumerics:
             ring_attention(q, k, v, mesh)
 
 
+class TestRingWithKernel:
+    """The flash kernel inside each ring chunk (interpret mode): the chunk
+    outputs recombine by logsumexp and must still match the single-device
+    oracle in values and gradients — VERDICT r1's 'use the kernel at the
+    level it was built for'."""
+
+    @pytest.fixture(autouse=True)
+    def force_interpret(self, monkeypatch):
+        monkeypatch.setenv("TPU_TRAINER_FLASH_INTERPRET", "1")
+
+    def test_kernel_chunks_match_reference(self):
+        mesh = _seq_mesh(4)
+        # chunk length 512/4 = 128: kernel-tileable.
+        q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 512, 2, 16)
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+        expected = reference_attention(q, k, v)
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh))(q, k, v)
+        np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
+
+    def test_kernel_chunk_gradients_match_reference(self):
+        mesh = _seq_mesh(4)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(6), 1, 512, 1, 16)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(jnp.sin(ring_attention(q, k, v, mesh)))
+
+        def loss_ref(q, k, v):
+            return jnp.sum(jnp.sin(reference_attention(q, k, v)))
+
+        g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for got, expected, name in zip(g_ring, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                got, expected, atol=5e-5, rtol=5e-5, err_msg=f"d{name}"
+            )
+
+    def test_dropout_under_sp(self):
+        # Attention dropout under ring attention (previously
+        # NotImplementedError): deterministic per key, varies across keys,
+        # zero-rate reduces to the exact no-dropout output.
+        mesh = _seq_mesh(4)
+        q, k, v = _rand_qkv(jax.random.PRNGKey(7), 1, 512, 2, 16)
+
+        def run(rate, seed):
+            return ring_attention(
+                q, k, v, mesh, dropout_rate=rate,
+                dropout_rng=jax.random.PRNGKey(seed),
+            )
+
+        base = run(0.0, 0)
+        d1a, d1b, d2 = run(0.5, 1), run(0.5, 1), run(0.5, 2)
+        np.testing.assert_allclose(d1a, d1b, atol=0)          # deterministic
+        assert not np.allclose(d1a, d2, atol=1e-3)            # key-dependent
+        assert not np.allclose(d1a, base, atol=1e-3)          # actually drops
+        # E[dropout output] == base (inverted-dropout scaling): the mean
+        # over keys is an unbiased estimate, so the average deviation must
+        # be small (a mis-scaled 1/(1-rate) would bias every element ~2x).
+        outs = np.stack([np.asarray(run(0.5, s)) for s in range(1, 17)])
+        bias = np.abs(outs.mean(0) - np.asarray(base)).mean()
+        assert bias < 0.05, bias
+
+
 class TestSequenceParallelTraining:
     def _tiny_config(self):
         return GPTConfig(
@@ -125,6 +187,24 @@ class TestSequenceParallelTraining:
             losses[name] = float(metrics["loss"])
         assert losses["ddp"] == pytest.approx(losses["sp4"], rel=1e-5)
         assert losses["ddp"] == pytest.approx(losses["fsdp2_sp4"], rel=1e-5)
+
+    def test_sp_trains_with_reference_default_dropout(self):
+        # Previously NotImplementedError: reference-default configs
+        # (dropout 0.1 everywhere) couldn't run under sequence parallelism.
+        import dataclasses as dc
+
+        model_cfg = dc.replace(
+            self._tiny_config(), dropout=0.1, attention_dropout=0.1
+        )
+        trainer = Trainer(
+            model_cfg, self._train_cfg(2),
+            ParallelConfig(mesh=MeshConfig(data=2, fsdp=1, sequence=4)),
+        )
+        batch = np.random.default_rng(0).integers(0, 128, (8, 64), np.int32)
+        state = trainer.init_state(seed=0)
+        for _ in range(2):
+            state, metrics = trainer.train_step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
 
     def test_sp_rejects_indivisible_seq_len(self):
         import dataclasses as dc

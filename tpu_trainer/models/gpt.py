@@ -113,15 +113,15 @@ class CausalSelfAttention(nn.Module):
             dropout_rng = self.make_rng("dropout") if needs_rng else None
             sp_ctx = ring.current_context()
             if sp_ctx is not None and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1:
-                # Sequence parallelism: K/V ring over the mesh's sequence axis.
-                if needs_rng:
-                    raise NotImplementedError(
-                        "attention dropout is not supported under ring "
-                        "attention; set attention_dropout=0 for sequence "
-                        "parallelism"
-                    )
+                # Sequence parallelism: K/V ring over the mesh's sequence
+                # axis, each chunk through the flash kernel where available
+                # (ops/ring.py). Attention dropout runs per chunk.
                 q, k = apply_rotary_pos_emb(q, k, cos, sin)
-                out = ring.ring_attention(q, k, v, sp_ctx.mesh, sp_ctx.axis_name)
+                out = ring.ring_attention(
+                    q, k, v, sp_ctx.mesh, sp_ctx.axis_name,
+                    dropout_rate=cfg.attention_dropout if needs_rng else 0.0,
+                    dropout_rng=dropout_rng,
+                )
             elif cfg.use_flash_attention:
                 # RoPE rides into the kernel (rotation happens in VMEM on
                 # TPU; external otherwise — ops/attention.py decides).

@@ -81,14 +81,13 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+    from tpu_trainer.parallel.mesh import (
+        attention_shard_coord, attention_shard_spec,
+    )
     from tpu_trainer.ops import flash
 
     b, _, h, _ = q.shape
-    dp = mesh.shape.get(DATA_AXIS, 1) * mesh.shape.get(FSDP_AXIS, 1)
-    b_spec = (DATA_AXIS, FSDP_AXIS) if (dp > 1 and b % dp == 0) else None
-    tp = mesh.shape.get(TENSOR_AXIS, 1)
-    h_spec = TENSOR_AXIS if (tp > 1 and h % tp == 0) else None
+    b_spec, h_spec = attention_shard_spec(mesh, b, h)
     if b_spec is None and h_spec is None:
         return flash.flash_attention(q, k, v, **kernel_kwargs)
     spec = P(b_spec, None, h_spec, None)
@@ -111,18 +110,9 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
         i = 0
         rng_local = None
         if has_rng:
-            # Decorrelate the in-kernel dropout mask across shards — but only
-            # along axes that actually shard the inputs: folding a replicated
-            # axis's coordinate in would make devices along it compute
-            # *different* outputs for identical data, breaking the replicated
-            # out_spec.
-            coord = jax.lax.axis_index(TENSOR_AXIS) if h_spec else 0
-            if b_spec is not None:
-                coord = coord * dp + jax.lax.axis_index(
-                    DATA_AXIS
-                ) * mesh.shape.get(FSDP_AXIS, 1) + jax.lax.axis_index(
-                    FSDP_AXIS
-                )
+            # Decorrelate the in-kernel dropout mask across (sharded-axis)
+            # shards — see attention_shard_coord.
+            coord = attention_shard_coord(mesh, b_spec, h_spec)
             rng_local = jax.random.fold_in(extra[0], coord)
             i = 1
         rope_local = (extra[i], extra[i + 1]) if has_rope else None

@@ -404,13 +404,18 @@ def _bwd_fused_kernel(
 
 
 def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
-                    block_k, interpret, dropout_rate):
+                    block_k, interpret, dropout_rate, dlse=None):
     b, h, s, d = q.shape
     scale = 1.0 / math.sqrt(d)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term.
     delta = jnp.einsum(
         "bhsd,bhsd->bhs", do.astype(jnp.float32), o.astype(jnp.float32)
     )[:, :, None, :]
+    if dlse is not None:
+        # lse is an exposed output (return_lse path): its cotangent enters
+        # the score gradient as ds += p * dlse, i.e. exactly a -dlse shift
+        # of the delta row — no kernel change needed.
+        delta = delta - dlse.astype(jnp.float32)[:, :, None, :]
 
     seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     blk = lambda n: pl.BlockSpec((1, 1, n, d), lambda ib, ih, i: (ib, ih, i, 0))
@@ -449,7 +454,7 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
 @functools.lru_cache(maxsize=None)
 def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
                 dropout_rate: float, num_heads: int, head_dim: int,
-                fuse_rope: bool):
+                fuse_rope: bool, return_lse: bool = False):
     """custom_vjp'd kernel entry over *folded* ``[b, s, h*d]`` operands.
 
     The fold matters for memory: with head_dim 64, BSHD/BHSD tensors pad
@@ -478,6 +483,35 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
             to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), seed_f, rope, **kw
         )
         return to_flat(o), lse
+
+    if return_lse:
+        # (o, lse [b, h, s]) variant for blockwise composition (ring
+        # attention combines per-chunk outputs by their logsumexps, so the
+        # lse is a *differentiated* output — its cotangent folds into the
+        # backward's delta row, see _flash_backward).
+        @jax.custom_vjp
+        def flash(q3, k3, v3, seed_f, cos, sin):
+            o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)
+            return o3, lse[:, :, 0, :]
+
+        def fwd(q3, k3, v3, seed_f, cos, sin):
+            o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)
+            return (o3, lse[:, :, 0, :]), (q3, k3, v3, o3, lse, seed_f, cos, sin)
+
+        def bwd(res, cot):
+            do3, dlse = cot
+            q3, k3, v3, o3, lse, seed_f, cos, sin = res
+            rope = (cos, sin) if fuse_rope else None
+            dq, dk, dv = _flash_backward(
+                to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), to_bhsd(o3), lse,
+                to_bhsd(do3), seed_f, rope, dlse=dlse, **kw
+            )
+            return (to_flat(dq), to_flat(dk), to_flat(dv),
+                    jnp.zeros_like(seed_f), jnp.zeros_like(cos),
+                    jnp.zeros_like(sin))
+
+        flash.defvjp(fwd, bwd)
+        return flash
 
     @jax.custom_vjp
     def flash(q3, k3, v3, seed_f, cos, sin):
@@ -514,6 +548,7 @@ def flash_attention(
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
     rope: Optional[tuple] = None,
+    return_lse: bool = False,
 ) -> jax.Array:
     """Blockwise causal flash attention; BSHD in, BSHD out.
 
@@ -530,6 +565,12 @@ def flash_attention(
     b, s, h, d = q.shape
     if dropout_rate > 0.0 and dropout_rng is None:
         raise ValueError("dropout_rate > 0 requires dropout_rng")
+    if return_lse and (s % 128 != 0 or s < 128):
+        # The lse variant exists for blockwise composition (ring attention);
+        # its callers check tiling first, so this is a programming error.
+        raise NotImplementedError(
+            f"return_lse requires a kernel-tileable sequence (s={s})"
+        )
     # Largest block <= the requested size that divides the sequence, so e.g.
     # seq=768 runs the kernel with 256-blocks rather than falling back to
     # the O(seq^2) path.
@@ -575,7 +616,7 @@ def flash_attention(
         cos = sin = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
     fn = _make_flash(
         causal, block_q, block_k, interpret, float(dropout_rate), h, d,
-        fuse_rope,
+        fuse_rope, return_lse,
     )
     # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals);
     # the kernel-internal layout is BHSD for the (seq, head_dim) tiling.
@@ -583,4 +624,7 @@ def flash_attention(
         q.reshape(b, s, h * d), k.reshape(b, s, h * d),
         v.reshape(b, s, h * d), seed_f, cos, sin,
     )
+    if return_lse:
+        o3, lse = out
+        return o3.reshape(b, s, h, d), lse
     return out.reshape(b, s, h, d)

@@ -70,45 +70,117 @@ def current_context() -> Optional[SequenceParallelContext]:
     return _ACTIVE
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, sp: int, scale: float):
-    """Per-device body under shard_map. q, k, v: local ``[b, sl, h, d]``."""
+def _kernel_mode(sl: int):
+    """``(use_kernel, interpret)`` for a chunk length: the kernel runs when
+    the chunk tiles the Pallas blocks and either a TPU is present or
+    interpret mode is forced (the CPU test hook shared with the attention
+    dispatch)."""
+    import os
+
+    from tpu_trainer.ops.attention import _INTERPRET_ENV
+
+    interpret = os.environ.get(_INTERPRET_ENV, "0") == "1"
+    if sl % 128 != 0:
+        return False, interpret
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    return (on_tpu or interpret), interpret
+
+
+def _chunk_attention_jnp(q, k, v, causal, scale, dropout_rate, rng):
+    """jnp fallback for one chunk: normalized attention + per-row lse.
+
+    Same contract as the kernel path: returns ``(o [b,sl,h,d], lse
+    [b,h,sl])`` where ``o`` is softmax-within-chunk (dropout applied to the
+    normalized weights) and ``lse`` the *undropped* log-normalizer. Inputs
+    stay in their storage dtype (bf16 x bf16 -> f32 runs at full MXU rate)
+    with f32 accumulation — the flash kernel's dtype discipline.
+    """
+    b, sl, h, d = q.shape
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
+        s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                               # [b,h,sl]
+    lse = m + jnp.log(jnp.sum(jnp.exp(s - m[..., None]), axis=-1))
+    p = jnp.exp(s - lse[..., None])                       # normalized
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o, lse
+
+
+def _ring_attention_local(q, k, v, rng, *, axis_name: str, sp: int,
+                          scale: float, dropout_rate: float,
+                          use_kernel: bool, interpret: bool):
+    """Per-device body under shard_map. q, k, v: local ``[b, sl, h, d]``.
+
+    Each arriving K/V chunk is attended with the *flash kernel* (the chunk
+    is one chip's worth — exactly the granularity the kernel is tuned for),
+    returning per-chunk normalized outputs and logsumexps; chunks combine by
+    the standard lse recombination ``out = Σ o_t·exp(lse_t − M) / Σ
+    exp(lse_t − M)``. Only the t=0 chunk is the causal diagonal (a device
+    always starts holding its own K/V), so the kernel's static ``causal``
+    flag needs no dynamic dispatch: t=0 runs causal, every later chunk runs
+    non-causal and fully-future chunks (src > idx) are erased by setting
+    their lse to −inf before combining.
+
+    Attention-weight dropout is supported (in-kernel counter-based mask, or
+    bernoulli in the jnp fallback), decorrelated across (device, chunk)
+    pairs by folding ``idx·sp + src`` into the key.
+    """
     b, sl, h, d = q.shape
     idx = lax.axis_index(axis_name)
-
-    rows = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (sl, sl), 1)
-
-    m0 = jnp.full((b, h, sl, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sl, 1), jnp.float32)
-    acc0 = jnp.zeros((b, sl, h, d), jnp.float32)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def step(t, carry):
-        m, l, acc, k_t, v_t = carry
-        src = (idx - t) % sp  # global chunk id of the K/V currently held
-        # Inputs stay in their storage dtype (bf16 x bf16 -> f32 runs at full
-        # MXU rate; f32 matmuls cost ~8x) with f32 accumulation — the same
-        # dtype discipline as the flash kernel (ops/flash.py).
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_t, preferred_element_type=jnp.float32
-        ) * scale
-        # Global causal mask: query position idx*sl + r, key src*sl + c.
-        allowed = (idx * sl + rows) >= (src * sl + cols)
-        s = jnp.where(allowed[None, None], s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                     # [b,h,q,k]; 0 where masked
-        alpha = jnp.exp(m - m_new)                 # [b,h,q,1]
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        contrib = jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(v_t.dtype), v_t,
-            preferred_element_type=jnp.float32,
-        )
-        acc_new = acc * alpha[:, :, :, 0].transpose(0, 2, 1)[..., None] + contrib
-        k_n, v_n = lax.ppermute((k_t, v_t), axis_name, perm=perm)
-        return m_new, l_new, acc_new, k_n, v_n
+    def chunk(k_t, v_t, causal, rng_t):
+        if use_kernel:
+            from tpu_trainer.ops import flash
 
-    m, l, acc, _, _ = lax.fori_loop(0, sp, step, (m0, l0, acc0, k, v))
-    norm = l[:, :, :, 0].transpose(0, 2, 1)[..., None]   # [b, sl, h, 1]
+            return flash.flash_attention(
+                q, k_t, v_t, causal=causal, dropout_rate=dropout_rate,
+                dropout_rng=rng_t, interpret=interpret, return_lse=True,
+            )
+        return _chunk_attention_jnp(
+            q, k_t, v_t, causal, scale, dropout_rate, rng_t
+        )
+
+    def fold(t_src):
+        if dropout_rate > 0.0:
+            return jax.random.fold_in(rng, t_src)
+        return None
+
+    # t = 0: own chunk — the causal diagonal.
+    o0, lse0 = chunk(k, v, True, fold(idx * sp + idx))
+    acc = o0.astype(jnp.float32)
+    den = jnp.ones((b, h, sl), jnp.float32)
+    m = lse0
+
+    def step(t, carry):
+        m, den, acc, k_t, v_t = carry
+        k_t, v_t = lax.ppermute((k_t, v_t), axis_name, perm=perm)
+        src = (idx - t) % sp            # global chunk id of the K/V now held
+        o_t, lse_t = chunk(k_t, v_t, False, fold(idx * sp + src))
+        # Fully-future chunk (src > idx): no key precedes any query here —
+        # erase its contribution through the lse.
+        lse_t = jnp.where(src > idx, _NEG_INF, lse_t)
+        m_new = jnp.maximum(m, lse_t)
+        alpha = jnp.exp(m - m_new)                        # [b,h,sl]
+        w = jnp.exp(lse_t - m_new)
+        to_bshd = lambda x: x.transpose(0, 2, 1)[..., None]
+        acc = acc * to_bshd(alpha) + o_t.astype(jnp.float32) * to_bshd(w)
+        den = den * alpha + w
+        return m_new, den, acc, k_t, v_t
+
+    if sp > 1:
+        m, den, acc, _, _ = lax.fori_loop(1, sp, step, (m, den, acc, k, v))
+    norm = den.transpose(0, 2, 1)[..., None]              # [b, sl, h, 1]
     return (acc / norm).astype(q.dtype)
 
 
@@ -118,36 +190,63 @@ def ring_attention(
     v: jax.Array,
     mesh: Mesh,
     axis_name: str = SEQ_AXIS,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal ring attention; global BSHD in/out, seq sharded over ``axis_name``.
 
     Requires ``seq % axis_size == 0``. With ``axis_size == 1`` this is plain
-    blockwise attention (one step, no communication).
+    blockwise attention (one step, no communication). On TPU (or with
+    ``TPU_TRAINER_FLASH_INTERPRET=1``) each chunk runs through the Pallas
+    flash kernel — chunk results recombine by logsumexp — so the
+    long-context path keeps the kernel's memory profile and MXU efficiency
+    instead of materializing [b, h, s/sp, s/sp] score blocks.
+    ``dropout_rate > 0`` applies attention-weight dropout per chunk (the
+    reference's semantics), decorrelated across devices and chunks.
     """
     b, s, h, d = q.shape
     sp = mesh.shape[axis_name]
     if s % sp != 0:
         raise ValueError(f"seq {s} not divisible by {axis_name} axis size {sp}")
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
     scale = 1.0 / math.sqrt(d)
     # Keep the surrounding activation sharding across the shard_map boundary:
     # batch stays split over data x fsdp and heads over tensor (attention is
-    # independent across both), so no all-gather is forced on entry. Axes
-    # that don't divide the dim (tiny test batches) fall back to replicated.
-    from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS, TENSOR_AXIS
+    # independent across both), so no all-gather is forced on entry
+    # (parallel/mesh.py:attention_shard_spec).
+    from tpu_trainer.parallel.mesh import (
+        attention_shard_coord, attention_shard_spec,
+    )
 
-    batch_axes = (DATA_AXIS, FSDP_AXIS)
-    dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
-    b_spec = batch_axes if (dp > 1 and b % dp == 0) else None
-    tp = mesh.shape[TENSOR_AXIS]
-    h_spec = TENSOR_AXIS if (tp > 1 and h % tp == 0) else None
+    b_spec, h_spec = attention_shard_spec(mesh, b, h)
     spec = P(b_spec, axis_name, h_spec, None)
+    import functools
+
+    sl = s // sp
+    use_kernel, interpret = _kernel_mode(sl)
+    body = functools.partial(
+        _ring_attention_local, axis_name=axis_name, sp=sp, scale=scale,
+        dropout_rate=dropout_rate, use_kernel=use_kernel, interpret=interpret,
+    )
+    if dropout_rng is None:
+        dropout_rng = jax.random.PRNGKey(0)  # unused when rate == 0
+
+    def local(q, k, v, rng):
+        if dropout_rate > 0.0:
+            # Distinct masks per batch/head shard too (chunk-level folding
+            # happens inside the body).
+            rng = jax.random.fold_in(
+                rng, attention_shard_coord(mesh, b_spec, h_spec)
+            )
+        return body(q, k, v, rng)
+
     fn = shard_map(
-        lambda q, k, v: _ring_attention_local(
-            q, k, v, axis_name=axis_name, sp=sp, scale=scale
-        ),
+        local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, P()),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, dropout_rng)

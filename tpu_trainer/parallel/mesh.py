@@ -158,6 +158,44 @@ def dp_size(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
 
 
+def attention_shard_spec(mesh: Mesh, batch: int, heads: int):
+    """PartitionSpec components for ``[b, s, h, d]`` attention operands.
+
+    Attention is independent across batch and heads, so those dims shard
+    losslessly: batch over ``data x fsdp`` (every device is both a data and
+    a shard rank, as in torch FSDP) and heads over ``tensor``. An axis whose
+    size doesn't divide the dim (tiny test batches) falls back to
+    replicated. Shared by the flash-kernel shard_map wrapper
+    (``ops/attention.py``) and ring attention (``ops/ring.py``).
+
+    Returns ``(b_spec, h_spec)`` — each an axis (tuple) or None.
+    """
+    dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    b_spec = (DATA_AXIS, FSDP_AXIS) if (dp > 1 and batch % dp == 0) else None
+    tp = mesh.shape[TENSOR_AXIS]
+    h_spec = TENSOR_AXIS if (tp > 1 and heads % tp == 0) else None
+    return b_spec, h_spec
+
+
+def attention_shard_coord(mesh: Mesh, b_spec, h_spec):
+    """Linearized coordinate of this shard along the axes that actually
+    shard the attention inputs (0 when none). Must be called inside the
+    shard_map body. Folding this into a dropout PRNG key decorrelates masks
+    across shards — and *only* across sharded axes: folding a replicated
+    axis's coordinate would make devices along it compute different outputs
+    for identical data, breaking the replicated out_spec.
+    """
+    coord = 0
+    if b_spec is not None:
+        for ax in (DATA_AXIS, FSDP_AXIS):
+            coord = coord * mesh.shape[ax] + jax.lax.axis_index(ax)
+    if h_spec is not None:
+        coord = coord * mesh.shape[TENSOR_AXIS] + jax.lax.axis_index(
+            TENSOR_AXIS
+        )
+    return coord
+
+
 def barrier(name: str = "barrier") -> None:
     """Cross-host barrier (↔ ``dist.barrier()``, reference fsdp_trainer.py:465)."""
     if jax.process_count() > 1:
