@@ -1,0 +1,147 @@
+"""Input-pipeline overlap tests (VERDICT r1 missing #1).
+
+The reference gets host/device overlap from torch DataLoader workers +
+prefetch (``tinystories.py:131,153-161``); here the equivalents are
+``data/prefetch.py`` (background batch assembly) and
+``StreamingTextDataset(num_workers=...)`` (thread-pool tokenization). The
+load-bearing assertions: batches are produced *while the consumer blocks*
+(a mock device step), and the parallel paths are stream-identical to the
+serial ones.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_trainer.data.prefetch import Prefetcher
+from tpu_trainer.data.text import (
+    StreamingTextDataset, TextDataLoader, create_text_dataloader,
+)
+
+
+class TestPrefetcher:
+    def test_order_and_completeness(self):
+        items = list(range(57))
+        got = list(Prefetcher(lambda: iter(items), depth=3))
+        assert got == items
+
+    def test_reiteration_restarts(self):
+        pf = Prefetcher(lambda: iter([1, 2, 3]), depth=2)
+        assert list(pf) == [1, 2, 3]
+        assert list(pf) == [1, 2, 3]
+
+    def test_producer_exception_propagates(self):
+        def bad():
+            yield 1
+            raise RuntimeError("boom")
+
+        it = iter(Prefetcher(bad, depth=2))
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            list(it)
+
+    def test_early_break_stops_producer(self):
+        produced = []
+
+        def src():
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+
+        it = iter(Prefetcher(src, depth=2))
+        next(it), next(it)
+        it.close()  # consumer walks away
+        time.sleep(0.3)
+        n = len(produced)
+        time.sleep(0.2)
+        assert len(produced) == n  # producer stopped, not spinning
+
+    def test_produces_while_consumer_blocks(self):
+        """The point of the exercise: with the consumer stuck in a (mock)
+        device step, the background thread keeps assembling batches."""
+        produced = threading.Event()
+        state = {"n": 0}
+
+        def src():
+            for i in range(8):
+                state["n"] += 1
+                if state["n"] >= 3:
+                    produced.set()
+                yield i
+
+        it = iter(Prefetcher(src, depth=4))
+        _ = next(it)  # pull one batch, then "compute" for a while
+        assert produced.wait(timeout=2.0), (
+            f"producer built only {state['n']} items while consumer blocked"
+        )
+        assert list(it) == list(range(1, 8))
+
+    def test_zero_depth_rejected(self):
+        with pytest.raises(ValueError):
+            Prefetcher(lambda: iter([]), depth=0)
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    path = tmp_path / "corpus.txt"
+    rng = np.random.default_rng(0)
+    lines = [
+        " ".join(str(x) for x in rng.integers(0, 99, rng.integers(3, 40)))
+        for _ in range(300)
+    ]
+    path.write_text("\n".join(lines))
+    return str(path)
+
+
+class TestParallelTokenization:
+    def chunks(self, corpus, **kw):
+        ds = StreamingTextDataset(
+            corpus, seq_len=32, tokenizer_name="byte", **kw
+        )
+        return [c.tolist() for c in ds]
+
+    def test_workers_match_serial(self, corpus):
+        assert self.chunks(corpus, num_workers=4) == self.chunks(corpus)
+
+    def test_workers_match_serial_with_budget_and_shards(self, corpus):
+        for shard in (0, 1):
+            serial = self.chunks(
+                corpus, shard_id=shard, num_shards=2, max_tokens=900
+            )
+            parallel = self.chunks(
+                corpus, shard_id=shard, num_shards=2, max_tokens=900,
+                num_workers=3,
+            )
+            assert parallel == serial and serial
+
+    def test_workers_populate_cache(self, corpus):
+        ds = StreamingTextDataset(
+            corpus, seq_len=32, tokenizer_name="byte",
+            cache_max_tokens=10**6, num_workers=4,
+        )
+        list(ds)
+        assert len(ds.cache) > 0
+
+
+class TestLoaderPrefetch:
+    def test_loader_prefetch_matches_plain(self, corpus):
+        def batches(prefetch):
+            loader = create_text_dataloader(
+                corpus, batch_size=4, seq_len=32, tokenizer_name="byte",
+                streaming=True, prefetch=prefetch, num_workers=2,
+            )
+            return [b.tolist() for b in loader]
+
+        assert batches(2) == batches(0)
+
+    def test_map_style_prefetch_epochs_advance(self, corpus):
+        loader = create_text_dataloader(
+            corpus, batch_size=4, seq_len=32, tokenizer_name="byte",
+            prefetch=2,
+        )
+        e0 = [b.tolist() for b in loader]
+        e1 = [b.tolist() for b in loader]
+        assert len(e0) == len(e1) > 0
+        assert e0 != e1  # epoch-seeded reshuffle still happens

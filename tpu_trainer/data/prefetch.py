@@ -1,0 +1,78 @@
+"""Bounded background prefetch over an iterator.
+
+The reference gets input/compute overlap for free from torch's
+``DataLoader(num_workers=4)`` worker processes + prefetching
+(``/root/reference/src/data/tinystories.py:131,153-161``). The TPU-native
+loaders are plain host-side generators, so without this a streaming text run
+serializes host tokenization/batch assembly with device steps — the chip
+idles while the host reads lines. ``Prefetcher`` runs the inner iterator on
+a daemon thread into a bounded queue (double-buffering by default): the
+device consumes batch N while the host builds batch N+1.
+
+Threads (not processes) suffice here: the heavy per-item work — HF fast
+tokenizers (Rust) and the native byte-tokenize kernel — releases the GIL,
+and device dispatch overlaps regardless.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator
+
+
+class Prefetcher:
+    """Iterate ``make_iter()`` on a background thread, ``depth`` items ahead.
+
+    - Exceptions in the producer re-raise at the consumer's next pull.
+    - Early termination (consumer breaks / generator closed) signals the
+      producer to stop; the thread is a daemon either way.
+    - Each ``__iter__`` starts a fresh producer (epoch semantics match the
+      wrapped loader's).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, make_iter: Callable[[], Iterable], depth: int = 2):
+        if depth <= 0:
+            raise ValueError(f"prefetch depth must be positive, got {depth}")
+        self._make_iter = make_iter
+        self._depth = depth
+
+    def __iter__(self) -> Iterator:
+        q: queue.Queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        exc: list = []
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in self._make_iter():
+                    if not _put(item):
+                        return
+            except BaseException as e:  # re-raised on the consumer side
+                exc.append(e)
+            _put(self._SENTINEL)
+
+        thread = threading.Thread(
+            target=produce, daemon=True, name="tpu-trainer-prefetch"
+        )
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._SENTINEL:
+                    if exc:
+                        raise exc[0]
+                    return
+                yield item
+        finally:
+            stop.set()

@@ -35,6 +35,7 @@ Everything is host-side numpy; device placement happens in
 
 from __future__ import annotations
 
+import functools
 import gzip
 import os
 from collections import OrderedDict
@@ -118,10 +119,12 @@ class TextDataset:
         seq_len: int,
         tokenizer_name: str = "gpt2",
         max_tokens: Optional[int] = None,
+        num_workers: int = 0,
+        tokenizer_on_fallback: str = "warn",
     ):
         self.path = resolve_path(path)
         self.seq_len = seq_len
-        tokenizer = get_tokenizer(tokenizer_name)
+        tokenizer = get_tokenizer(tokenizer_name, on_fallback=tokenizer_on_fallback)
 
         arr: Optional[np.ndarray] = None
         if isinstance(tokenizer, ByteTokenizer):
@@ -147,16 +150,34 @@ class TextDataset:
                 arr = None
         if arr is None:
             ids: List[int] = []
-            with open_text(self.path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    ids.extend(tokenizer.encode(line))
-                    ids.append(tokenizer.eos_token_id)
-                    if max_tokens is not None and len(ids) >= max_tokens:
-                        ids = ids[:max_tokens]
-                        break
+            eos = tokenizer.eos_token_id
+            if num_workers > 0:
+                # Up-front tokenization parallelized over lines (the
+                # map-style analogue of streaming num_workers; HF fast
+                # tokenizers release the GIL).
+                from concurrent.futures import ThreadPoolExecutor
+
+                with open_text(self.path) as f:
+                    lines = [l.strip() for l in f if l.strip()]
+                with ThreadPoolExecutor(max_workers=num_workers) as pool:
+                    for toks in pool.map(tokenizer.encode, lines, chunksize=64):
+                        ids.extend(toks)
+                        ids.append(eos)
+                        if max_tokens is not None and len(ids) >= max_tokens:
+                            break
+                if max_tokens is not None:
+                    ids = ids[:max_tokens]
+            else:
+                with open_text(self.path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        ids.extend(tokenizer.encode(line))
+                        ids.append(eos)
+                        if max_tokens is not None and len(ids) >= max_tokens:
+                            ids = ids[:max_tokens]
+                            break
             arr = np.asarray(ids, dtype=np.int32)
 
         n_chunks = arr.size // seq_len
@@ -182,6 +203,10 @@ class StreamingTextDataset:
     reference behavior, SURVEY.md §2.1 b10).
     """
 
+    # Lines per tokenizer-pool submission; large enough to amortize thread
+    # handoff, small enough to keep the pipeline responsive.
+    _GROUP = 64
+
     def __init__(
         self,
         path: str,
@@ -191,30 +216,43 @@ class StreamingTextDataset:
         cache_max_tokens: Optional[int] = None,
         shard_id: int = 0,
         num_shards: int = 1,
+        num_workers: int = 0,
+        tokenizer_on_fallback: str = "warn",
     ):
         self.path = resolve_path(path)
         self.seq_len = seq_len
-        self.tokenizer = get_tokenizer(tokenizer_name)
+        self.tokenizer = get_tokenizer(
+            tokenizer_name, on_fallback=tokenizer_on_fallback
+        )
         self.max_tokens = max_tokens
         self.shard_id = shard_id
         self.num_shards = num_shards
+        self.num_workers = num_workers
         self.cache = LRUTokenCache(cache_max_tokens)
 
+    def _encode(self, line: str) -> List[int]:
+        return self.tokenizer.encode(line) + [self.tokenizer.eos_token_id]
+
+    def _sharded_lines(self, f) -> Iterator[tuple]:
+        """(line_idx, stripped line) pairs belonging to this shard."""
+        for line_idx, line in enumerate(f):
+            if line_idx % self.num_shards != self.shard_id:
+                continue
+            line = line.strip()
+            if line:
+                yield line_idx, line
+
     def __iter__(self) -> Iterator[np.ndarray]:
+        if self.num_workers > 0:
+            yield from self._iter_parallel()
+            return
         buffer: List[int] = []
         tokens_seen = 0
         with open_text(self.path) as f:
-            for line_idx, line in enumerate(f):
-                if line_idx % self.num_shards != self.shard_id:
-                    continue
-                line = line.strip()
-                if not line:
-                    continue
+            for line_idx, line in self._sharded_lines(f):
                 tokens = self.cache.get(line_idx)
                 if tokens is None:
-                    tokens = self.tokenizer.encode(line) + [
-                        self.tokenizer.eos_token_id
-                    ]
+                    tokens = self._encode(line)
                     self.cache.put(line_idx, tokens)
                 # max_tokens budget (reference tinystories.py:103-108)
                 if self.max_tokens is not None:
@@ -228,6 +266,64 @@ class StreamingTextDataset:
                     yield np.asarray(buffer[: self.seq_len], dtype=np.int32)
                     buffer = buffer[self.seq_len :]
 
+    def _iter_parallel(self) -> Iterator[np.ndarray]:
+        """Same stream, with uncached lines tokenized by a thread pool in
+        groups (the ``num_workers`` knob — reference ``tinystories.py:131``;
+        HF fast tokenizers release the GIL, so threads parallelize for
+        real). Chunk order, LRU caching, and the ``max_tokens`` budget are
+        identical to the serial path.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        buffer: List[int] = []
+        tokens_seen = 0
+
+        with open_text(self.path) as f, ThreadPoolExecutor(
+            max_workers=self.num_workers
+        ) as pool:
+            group: List[tuple] = []  # (line_idx, line, cached | None)
+
+            def resolved(group):
+                uncached = [(i, l) for i, l, t in group if t is None]
+                encoded = dict(
+                    zip(
+                        (i for i, _ in uncached),
+                        pool.map(self._encode, (l for _, l in uncached)),
+                    )
+                )
+                for i, _, t in group:
+                    if t is None:
+                        t = encoded[i]
+                        self.cache.put(i, t)
+                    yield t
+
+            def emit(group):
+                nonlocal buffer, tokens_seen
+                for tokens in resolved(group):
+                    if self.max_tokens is not None:
+                        remaining = self.max_tokens - tokens_seen
+                        if remaining <= 0:
+                            return False
+                        tokens = tokens[:remaining]
+                    tokens_seen += len(tokens)
+                    buffer.extend(tokens)
+                    while len(buffer) >= self.seq_len:
+                        yield np.asarray(
+                            buffer[: self.seq_len], dtype=np.int32
+                        )
+                        buffer = buffer[self.seq_len :]
+                return True
+
+            for line_idx, line in self._sharded_lines(f):
+                group.append((line_idx, line, self.cache.get(line_idx)))
+                if len(group) >= self._GROUP:
+                    done = yield from emit(group)
+                    group = []
+                    if done is False:
+                        return
+            if group:
+                yield from emit(group)
+
 
 class TextDataLoader:
     """Batches chunks into ``[rows_per_host, seq_len]`` int32 arrays.
@@ -237,6 +333,11 @@ class TextDataLoader:
     ``ddp_trainer.py:538``). Map-style epochs reshuffle with an epoch-seeded
     permutation and stride disjoint rows per host (C25 + b11 fix); streaming
     shards lines per host (C22).
+
+    ``prefetch > 0`` assembles batches on a background thread, ``prefetch``
+    batches ahead (``data/prefetch.py``) — the torch-DataLoader overlap the
+    reference relies on: host tokenization/stacking runs while the device
+    executes the current step.
     """
 
     def __init__(
@@ -247,6 +348,7 @@ class TextDataLoader:
         process_count: int = 1,
         seed: int = 0,
         drop_last: bool = True,
+        prefetch: int = 2,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -254,10 +356,27 @@ class TextDataLoader:
         self.process_count = process_count
         self.seed = seed
         self.drop_last = drop_last
+        self.prefetch = prefetch
         self.epoch = 0
         self.streaming = not hasattr(dataset, "__len__")
 
     def __iter__(self) -> Iterator[np.ndarray]:
+        # Map-style epoch state advances HERE, on the consumer's thread, not
+        # inside the (possibly background-threaded) generator: with prefetch
+        # a consumer breaking early would otherwise leave "did the epoch
+        # advance?" up to producer-thread timing. Each __iter__ is one epoch.
+        epoch = self.epoch
+        if not self.streaming:
+            self.epoch += 1
+        make = functools.partial(self._iter_batches, epoch)
+        if self.prefetch > 0:
+            from tpu_trainer.data.prefetch import Prefetcher
+
+            yield from Prefetcher(make, self.prefetch)
+        else:
+            yield from make()
+
+    def _iter_batches(self, epoch: int) -> Iterator[np.ndarray]:
         if self.streaming:
             rows = []
             for chunk in self.dataset:
@@ -269,7 +388,7 @@ class TextDataLoader:
                 yield np.stack(rows)
         else:
             n = len(self.dataset)
-            rng = np.random.default_rng((self.seed, self.epoch))
+            rng = np.random.default_rng((self.seed, epoch))
             order = rng.permutation(n)
             # Disjoint per-host strides; drop the ragged tail so every host
             # sees the same number of full batches (drop_last=True,
@@ -281,7 +400,6 @@ class TextDataLoader:
             for b in range(n_batches):
                 idx = local[b * self.batch_size : (b + 1) * self.batch_size]
                 yield np.stack([self.dataset[i] for i in idx])
-            self.epoch += 1
 
     def __len__(self) -> int:
         if self.streaming:
@@ -302,9 +420,16 @@ def create_text_dataloader(
     process_index: int = 0,
     process_count: int = 1,
     seed: int = 0,
+    num_workers: int = 0,
+    prefetch: int = 2,
+    tokenizer_on_fallback: str = "warn",
 ) -> TextDataLoader:
     """Factory shared by the dataset-specific wrappers (reference factory
-    signatures: ``tinystories.py:122-134``, ``openwebtext.py:133-145``)."""
+    signatures: ``tinystories.py:122-134``, ``openwebtext.py:133-145``).
+    ``num_workers`` parallelizes tokenization (streaming and map-style);
+    ``prefetch`` overlaps batch assembly with device steps (0 disables).
+    ``tokenizer_on_fallback="error"`` is the training guardrail: no silent
+    byte-level fallback (utils/tokenizer.py)."""
     if streaming:
         dataset = StreamingTextDataset(
             path,
@@ -314,10 +439,14 @@ def create_text_dataloader(
             cache_max_tokens=cache_max_tokens,
             shard_id=process_index,
             num_shards=process_count,
+            num_workers=num_workers,
+            tokenizer_on_fallback=tokenizer_on_fallback,
         )
     else:
         dataset = TextDataset(
-            path, seq_len, tokenizer_name=tokenizer_name, max_tokens=max_tokens
+            path, seq_len, tokenizer_name=tokenizer_name,
+            max_tokens=max_tokens, num_workers=num_workers,
+            tokenizer_on_fallback=tokenizer_on_fallback,
         )
     return TextDataLoader(
         dataset,
@@ -325,4 +454,5 @@ def create_text_dataloader(
         process_index=process_index,
         process_count=process_count,
         seed=seed,
+        prefetch=prefetch,
     )

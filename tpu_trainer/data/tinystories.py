@@ -25,6 +25,9 @@ def create_tinystories_dataloader(
     process_index: int = 0,
     process_count: int = 1,
     seed: int = 0,
+    num_workers: int = 0,
+    prefetch: int = 2,
+    tokenizer_on_fallback: str = "warn",
 ) -> TextDataLoader:
     """Reference-parity factory (``tinystories.py:122-161``): ``batch_size``
     is rows per host; yields ``[batch_size, seq_len]`` int32 batches."""
@@ -39,4 +42,7 @@ def create_tinystories_dataloader(
         process_index=process_index,
         process_count=process_count,
         seed=seed,
+        num_workers=num_workers,
+        prefetch=prefetch,
+        tokenizer_on_fallback=tokenizer_on_fallback,
     )

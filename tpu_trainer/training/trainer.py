@@ -288,6 +288,18 @@ class Trainer:
         n, seq = local_batch.shape
         if n % accum != 0:
             raise ValueError(f"batch rows {n} not divisible by accum {accum}")
+        # Out-of-vocab ids make the embedding gather silently produce garbage
+        # (NaN loss a few steps later); a host-side max over the batch is
+        # ~free next to the device step. Typical trigger: byte tokenizer ids
+        # (<= 50256) against a shrunken vocab_size.
+        vocab = self.model_config.vocab_size
+        top = int(local_batch.max()) if local_batch.size else 0
+        if top >= vocab or int(local_batch.min() if local_batch.size else 0) < 0:
+            raise ValueError(
+                f"batch contains token id {top} outside [0, {vocab}) — "
+                f"tokenizer/vocab_size mismatch (e.g. byte-tokenizer ids "
+                f"with a reduced model vocab)"
+            )
         local = local_batch.reshape(accum, n // accum, seq)
         global_shape = (accum, (n // accum) * self.process_count, seq)
         return jax.make_array_from_process_local_data(
