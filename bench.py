@@ -1,57 +1,101 @@
-"""Benchmark harness: training throughput on the reference's headline config.
+"""Benchmark harness: training throughput, single-config and method x chips.
 
-Measures tokens/sec of the jitted train step on GPT-2 124M, batch_size=8,
-seq_len=1024 — the exact setup of the reference's example benchmark table
-(/root/reference/README.md:188-198, "12,500 tok/s" single-device row; see
-BASELINE.md). Prints ONE JSON line:
+Default invocation (the driver contract) measures tokens/sec of the jitted
+train step on GPT-2 124M, batch_size=8, seq_len=1024 — the exact setup of
+the reference's example benchmark table (/root/reference/README.md:188-198,
+"12,500 tok/s" single-device row; see BASELINE.md) — and prints ONE JSON
+line:
 
     {"metric": "train_tokens_per_sec", "value": N, "unit": "tok/s",
      "vs_baseline": N / 12500.0}
 
-Runs on whatever jax.devices() offers (one real TPU chip under the driver;
-CPU elsewhere). Environment overrides: BENCH_MODEL_SIZE, BENCH_BATCH_SIZE,
-BENCH_SEQ_LEN, BENCH_STEPS, BENCH_ACCUM, BENCH_FLASH=0/1, BENCH_REMAT=0/1
-(remat defaults on for medium/large/xl, matching the reference's configs).
+`--table` produces the reference README's method x chips table shape
+(DDP/FSDP x 1..N chips -> tok/s, tok/s/chip, peak memory, scaling
+efficiency), one JSON line per cell on stderr plus a markdown table;
+`--update-results` rewrites the scaling section of benchmarks/results.md in
+place. On this box the table runs at whatever jax.devices() offers: the one
+real TPU chip (1-chip rows), or a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu) as a
+correctness-mode dry run of the harness itself — the same command fills in
+real numbers the moment a pod exists.
+
+Env overrides (back-compat): BENCH_MODEL_SIZE, BENCH_BATCH_SIZE,
+BENCH_SEQ_LEN, BENCH_STEPS, BENCH_ACCUM, BENCH_FLASH=0/1, BENCH_REMAT=0/1.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import sys
 import time
 
+_RESULTS_MD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "results.md")
+_TABLE_START = "<!-- scaling-table:start -->"
+_TABLE_END = "<!-- scaling-table:end -->"
+_REF_BASELINE = 12500.0  # reference README.md:195 single-device example
 
-def main() -> None:
+
+def _build_parser():
+    env = os.environ.get
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model-size", default=env("BENCH_MODEL_SIZE", "small"))
+    p.add_argument("--batch-size", type=int,
+                   default=int(env("BENCH_BATCH_SIZE", "8")),
+                   help="rows per data shard per micro-step")
+    p.add_argument("--seq-len", type=int, default=int(env("BENCH_SEQ_LEN", "1024")))
+    p.add_argument("--steps", type=int, default=int(env("BENCH_STEPS", "20")))
+    p.add_argument("--accum", type=int, default=int(env("BENCH_ACCUM", "1")))
+    p.add_argument("--flash", type=int, default=int(env("BENCH_FLASH", "1")))
+    p.add_argument("--remat", type=int, default=None,
+                   help="default: on for medium/large/xl")
+    p.add_argument("--mesh-data", type=int, default=None)
+    p.add_argument("--mesh-fsdp", type=int, default=None)
+    p.add_argument("--mesh-tensor", type=int, default=1)
+    p.add_argument("--mesh-sequence", type=int, default=1)
+    p.add_argument("--mesh-stage", type=int, default=1)
+    p.add_argument("--strategy", default=None,
+                   help="replicated | zero2 | zero3 (reference spellings ok)")
+    p.add_argument("--table", action="store_true",
+                   help="run the method x chips scaling table")
+    p.add_argument("--update-results", action="store_true",
+                   help="rewrite the scaling table in benchmarks/results.md")
+    return p
+
+
+def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
+              remat, mesh_cfg, strategy, devices=None):
+    """One measured config -> result dict. ``batch_size`` is per data shard
+    (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
 
+    from tpu_trainer.data.dummy import create_dummy_dataloader
     from tpu_trainer.models.config import GPTConfig
+    from tpu_trainer.parallel.mesh import make_mesh
     from tpu_trainer.training.config import TrainingConfig
     from tpu_trainer.training.trainer import ParallelConfig, Trainer
-    from tpu_trainer.data.dummy import create_dummy_dataloader
-    from tpu_trainer.utils.logging import mfu
+    from tpu_trainer.utils.logging import memory_stats, mfu
 
-    model_size = os.environ.get("BENCH_MODEL_SIZE", "small")
-    batch_size = int(os.environ.get("BENCH_BATCH_SIZE", "8"))
-    seq_len = int(os.environ.get("BENCH_SEQ_LEN", "1024"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    accum = int(os.environ.get("BENCH_ACCUM", "1"))
-    use_flash = os.environ.get("BENCH_FLASH", "1") == "1"
-    remat_default = "0" if model_size == "small" else "1"
-    remat = os.environ.get("BENCH_REMAT", remat_default) == "1"
-
-    on_tpu = jax.devices()[0].platform == "tpu"
-    model_config = GPTConfig.preset(
-        model_size,
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    # Full reference-default dropout: the flash kernel implements
+    # attention-weight dropout in-kernel (counter-based mask), so the
+    # flash memory profile holds with dropout active.
+    common = dict(
         max_seq_len=seq_len,
         use_flash_attention=use_flash,
         gradient_checkpointing=remat,
-        # Full reference-default dropout: the flash kernel implements
-        # attention-weight dropout in-kernel (counter-based mask), so the
-        # flash memory profile holds with dropout active.
         dropout=0.1,
         attention_dropout=0.1,
     )
+    if model_size == "tiny":
+        # Correctness-mode size for CPU dry runs of the harness itself.
+        model_config = GPTConfig(vocab_size=256, hidden_size=64,
+                                 num_layers=2, num_heads=4, **common)
+    else:
+        model_config = GPTConfig.preset(model_size, **common)
     training_config = TrainingConfig(
         batch_size=batch_size,
         max_seq_len=seq_len,
@@ -59,7 +103,9 @@ def main() -> None:
         mixed_precision="bf16",
         log_interval=10**9,
     )
-    trainer = Trainer(model_config, training_config, ParallelConfig())
+    trainer = Trainer(model_config, training_config,
+                      ParallelConfig(mesh_cfg, strategy or "replicated"),
+                      mesh=mesh)
 
     loader = create_dummy_dataloader(
         batch_size=batch_size * accum * trainer.dp_size // trainer.process_count,
@@ -83,32 +129,171 @@ def main() -> None:
     final_loss = float(metrics["loss"])  # single end sync; steps are chained
     elapsed = time.perf_counter() - t0
 
+    n_chips = mesh.size
     tokens = steps * trainer.tokens_per_step
     tok_per_sec = tokens / elapsed
-    baseline = 12500.0  # reference README.md:195 single-device example figure
-
-    result = {
-        "metric": "train_tokens_per_sec",
-        "value": round(tok_per_sec, 1),
-        "unit": "tok/s",
-        "vs_baseline": round(tok_per_sec / baseline, 4),
-    }
-    # Side-channel detail for benchmarks/results.md (stderr keeps stdout to
-    # the single JSON line the driver parses).
-    detail = {
+    mem = memory_stats(next(iter(mesh.devices.flat)))
+    return {
         "model_size": model_size,
         "params": model_config.num_parameters(),
         "batch_size": batch_size,
+        "global_batch": trainer.global_batch_size,
         "seq_len": seq_len,
         "accum": accum,
         "steps": steps,
-        "platform": jax.devices()[0].platform,
-        "n_devices": jax.device_count(),
+        "platform": next(iter(mesh.devices.flat)).platform,
+        "n_chips": n_chips,
+        "mesh": dict(mesh.shape),
+        "strategy": strategy or "replicated",
         "elapsed_s": round(elapsed, 3),
-        "tok_per_sec_per_chip": round(tok_per_sec / jax.device_count(), 1),
+        "tok_per_sec": round(tok_per_sec, 1),
+        "tok_per_sec_per_chip": round(tok_per_sec / n_chips, 1),
         "mfu": round(mfu(tok_per_sec, model_config), 4) if on_tpu else None,
+        "peak_mem_gb": round(mem["peak_bytes_in_use"] / 2**30, 2)
+        if mem.get("peak_bytes_in_use") else None,
         "final_loss": final_loss,
     }
+
+
+def _chip_counts(n: int):
+    c, out = 1, []
+    while c <= n:
+        out.append(c)
+        c *= 2
+    if out[-1] != n:
+        out.append(n)
+    return out
+
+
+def run_table(args):
+    """Method x chips (reference README.md:188-198 table shape)."""
+    import jax
+
+    from tpu_trainer.parallel.mesh import MeshConfig
+
+    n = jax.device_count()
+    rows = []
+    base_per_method = {}
+    for method in ("DDP", "FSDP"):
+        for chips in _chip_counts(n):
+            if method == "FSDP" and chips == 1:
+                continue  # 1-chip FSDP is DDP
+            mesh_cfg = (MeshConfig(data=chips, fsdp=1) if method == "DDP"
+                        else MeshConfig(data=1, fsdp=chips))
+            strategy = "replicated" if method == "DDP" else "zero3"
+            r = run_bench(
+                model_size=args.model_size, batch_size=args.batch_size,
+                seq_len=args.seq_len, steps=args.steps, accum=args.accum,
+                use_flash=bool(args.flash), remat=_remat(args),
+                mesh_cfg=mesh_cfg, strategy=strategy,
+                devices=jax.devices()[:chips],
+            )
+            r["method"] = method
+            base = base_per_method.setdefault(
+                "1chip", r["tok_per_sec"] if chips == 1 else None
+            )
+            if base:
+                r["scaling_efficiency"] = round(
+                    r["tok_per_sec"] / (base * chips), 3
+                )
+            else:
+                r["scaling_efficiency"] = None
+            rows.append(r)
+            print(json.dumps(r), file=sys.stderr)
+    return rows
+
+
+def format_table(rows) -> str:
+    lines = [
+        "| Method | Chips | tok/s | tok/s/chip | Peak mem/chip | MFU | Scaling eff. |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        mem = f"{r['peak_mem_gb']:.2f} GB" if r["peak_mem_gb"] else "n/a"
+        mfu_s = f"{100 * r['mfu']:.1f}%" if r["mfu"] else "n/a"
+        eff = (f"{100 * r['scaling_efficiency']:.0f}%"
+               if r.get("scaling_efficiency") else "—")
+        lines.append(
+            f"| {r['method']} | {r['n_chips']} | {r['tok_per_sec']:,.0f} "
+            f"| {r['tok_per_sec_per_chip']:,.0f} | {mem} | {mfu_s} | {eff} |"
+        )
+    return "\n".join(lines)
+
+
+def update_results_md(rows, args) -> None:
+    table = format_table(rows)
+    header = (
+        f"Measured by `python bench.py --table` — {args.model_size}, "
+        f"batch {args.batch_size}/shard, seq {args.seq_len}, "
+        f"platform {rows[0]['platform']} "
+        f"({time.strftime('%Y-%m-%d')}).\n\n"
+    )
+    block = f"{_TABLE_START}\n{header}{table}\n{_TABLE_END}"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if _TABLE_START in text:
+        pre = text.split(_TABLE_START)[0]
+        post = text.split(_TABLE_END)[1]
+        text = pre + block + post
+    else:
+        text += "\n" + block + "\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote scaling table to {_RESULTS_MD}", file=sys.stderr)
+
+
+def _remat(args):
+    if args.remat is not None:
+        return bool(args.remat)
+    env = os.environ.get("BENCH_REMAT")
+    if env is not None:
+        return env == "1"
+    return args.model_size not in ("small", "tiny")
+
+
+def main() -> None:
+    # Honor JAX_PLATFORMS even when a site hook pre-registered an
+    # accelerator plugin at interpreter start (same workaround as
+    # tests/conftest.py) — this is what makes the CPU correctness-mode
+    # table (JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=N)
+    # work on a box with a real chip attached.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+    args = _build_parser().parse_args()
+    if args.table:
+        rows = run_table(args)
+        print(format_table(rows))
+        if args.update_results:
+            update_results_md(rows, args)
+        return
+
+    from tpu_trainer.parallel.mesh import MeshConfig
+
+    mesh_cfg = MeshConfig(
+        data=args.mesh_data if args.mesh_data is not None
+        else (-1 if args.mesh_fsdp is None else 1),
+        fsdp=args.mesh_fsdp if args.mesh_fsdp is not None else 1,
+        sequence=args.mesh_sequence,
+        tensor=args.mesh_tensor,
+        stage=args.mesh_stage,
+    )
+    detail = run_bench(
+        model_size=args.model_size, batch_size=args.batch_size,
+        seq_len=args.seq_len, steps=args.steps, accum=args.accum,
+        use_flash=bool(args.flash), remat=_remat(args),
+        mesh_cfg=mesh_cfg, strategy=args.strategy,
+    )
+    result = {
+        "metric": "train_tokens_per_sec",
+        "value": detail["tok_per_sec"],
+        "unit": "tok/s",
+        "vs_baseline": round(detail["tok_per_sec"] / _REF_BASELINE, 4),
+    }
+    # Side-channel detail (stderr keeps stdout to the single JSON line the
+    # driver parses).
     print(json.dumps(result))
     print(json.dumps(detail), file=sys.stderr)
 

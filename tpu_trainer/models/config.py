@@ -101,6 +101,10 @@ class GPTConfig:
     fused_loss: bool = True
     # Sequence-chunk length for fused_loss; 0 = auto (~8k tokens per chunk).
     loss_chunk_size: int = 0
+    # GPipe microbatch count when the mesh has a `stage` axis > 1
+    # (parallel/pipeline.py); 0 = auto (one microbatch per stage). More
+    # microbatches -> smaller pipeline bubble, smaller per-step matmuls.
+    pipeline_microbatches: int = 0
     # Counter-based dropout masks (ops/dropout.py) instead of threefry
     # bernoulli: same Bernoulli semantics, ~5x cheaper mask generation
     # (threefry masks measured ~9% of the headline step). Applies to the

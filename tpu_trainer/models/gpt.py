@@ -333,19 +333,15 @@ class GPT(nn.Module):
             "dots": jax.checkpoint_policies.dots_saveable,
         }
         carry0 = (x, jnp.zeros((), jnp.float32))
-        if cfg.scan_unroll and not decode and not self.is_initializing():
-            # Unrolled apply path: parameters keep the nn.scan layout
-            # ([num_layers, ...] stacked leaves, created by the scan branch
-            # at init — checkpoint/sharding layout unchanged), but each layer
-            # runs as straight-line code on a static slice. This removes the
-            # scan's stacking machinery: per-layer saved activations are
-            # plain fusion outputs instead of dynamic-update-slices into
-            # [num_layers, ...] buffers, and _unstack_layers turns the
-            # stacked param gradient into one concatenate (see its
-            # docstring). Measured ~20% faster than the rolled scan at
-            # headline geometry; the rolled path remains for decode (cache
-            # collection) and very deep models (compile time).
-            per_layer = _unstack_layers(self.variables["params"]["layers"])
+        from tpu_trainer.parallel import context as ctx_lib
+
+        ctx_mesh = ctx_lib.current_mesh()
+        stage_n = ctx_mesh.shape.get("stage", 1) if ctx_mesh is not None else 1
+        manual_apply = not decode and not self.is_initializing()
+        if manual_apply and (stage_n > 1 or cfg.scan_unroll):
+            # Shared setup for the two manual apply paths (pipeline and
+            # unrolled): one detached block module, dropout-rng gating, and
+            # optional remat wrapping.
             block_mod = TransformerBlock(cfg, deterministic=not train)
             needs_rng = train and (
                 cfg.dropout > 0.0 or cfg.attention_dropout > 0.0
@@ -360,6 +356,42 @@ class GPT(nn.Module):
                     run_block, prevent_cse=False,
                     policy=policies[cfg.remat_policy],
                 )
+        if manual_apply and stage_n > 1:
+            # Pipeline parallelism: the stacked layers (sharded over `stage`
+            # by parallel/sharding.py) run through the GPipe schedule
+            # (parallel/pipeline.py). Embedding / final norm / loss stay
+            # outside, replicated over the stage axis. Dense models only
+            # (the Trainer validates); the MoE aux is therefore zero. The
+            # flash dispatch still shard_maps the kernel inside the stage
+            # body — its manual region covers only batch/head axes, disjoint
+            # from `stage` (ops/attention.py).
+            from tpu_trainer.parallel.pipeline import pipeline_forward
+
+            def block_fn(p, xm, rng=None):
+                out, _aux = run_block(
+                    p, (xm, jnp.zeros((), jnp.float32)), rng
+                )
+                return out
+
+            rng = self.make_rng("dropout") if needs_rng else None
+            x = pipeline_forward(
+                self.variables["params"]["layers"], x, block_fn, ctx_mesh,
+                cfg.pipeline_microbatches or stage_n, rng=rng,
+            )
+            moe_aux = jnp.zeros((), jnp.float32)
+        elif manual_apply and cfg.scan_unroll:
+            # Unrolled apply path: parameters keep the nn.scan layout
+            # ([num_layers, ...] stacked leaves, created by the scan branch
+            # at init — checkpoint/sharding layout unchanged), but each layer
+            # runs as straight-line code on a static slice. This removes the
+            # scan's stacking machinery: per-layer saved activations are
+            # plain fusion outputs instead of dynamic-update-slices into
+            # [num_layers, ...] buffers, and _unstack_layers turns the
+            # stacked param gradient into one concatenate (see its
+            # docstring). Measured ~20% faster than the rolled scan at
+            # headline geometry; the rolled path remains for decode (cache
+            # collection) and very deep models (compile time).
+            per_layer = _unstack_layers(self.variables["params"]["layers"])
             carry = carry0
             for p in per_layer:
                 rng = self.make_rng("dropout") if needs_rng else None

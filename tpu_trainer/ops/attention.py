@@ -120,11 +120,30 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
             q, k, v, dropout_rng=rng_local, rope=rope_local, **static_kwargs
         )
 
+    # Manual only over the axes this wrapper actually shards: other axes
+    # (e.g. a pipeline `stage` axis whose manual region we may be nested
+    # inside) stay untouched, letting the kernel keep its batch/head
+    # sharding inside the GPipe stage body. When tracing inside another
+    # manual region, shard_map requires the *context* abstract mesh (same
+    # axes, with the outer region's axes typed Manual) rather than the
+    # concrete mesh.
+    used_axes = set()
+    if b_spec is not None:
+        used_axes.update(b_spec)
+    if h_spec is not None:
+        used_axes.add(h_spec)
+    from jax.sharding import get_abstract_mesh
+
+    ctx_mesh = get_abstract_mesh()
+    sm_mesh = mesh
+    if getattr(ctx_mesh, "shape_tuple", ()) and ctx_mesh.shape == mesh.shape:
+        sm_mesh = ctx_mesh
     fn = shard_map(
         local,
-        mesh=mesh,
+        mesh=sm_mesh,
         in_specs=(spec, spec, spec) + extra_specs,
         out_specs=spec,
+        axis_names=used_axes,
         check_vma=False,
     )
     return fn(q, k, v, *extras)

@@ -26,16 +26,19 @@ from jax.sharding import Mesh
 
 @dataclasses.dataclass(frozen=True)
 class MeshContext:
-    mesh: Mesh
+    mesh: Optional[Mesh]
 
 
 _ACTIVE: Optional[MeshContext] = None
 
 
 @contextlib.contextmanager
-def mesh_scope(mesh: Mesh):
+def mesh_scope(mesh: Optional[Mesh]):
     """While active (static, trace-time), mesh-aware ops may shard_map
-    themselves over ``mesh`` instead of appearing opaque to GSPMD."""
+    themselves over ``mesh`` instead of appearing opaque to GSPMD.
+    ``mesh_scope(None)`` masks an outer scope — used inside already-manual
+    regions (the pipeline stage body) where a nested kernel shard_map over
+    the same mesh would be invalid."""
     global _ACTIVE
     prev = _ACTIVE
     _ACTIVE = MeshContext(mesh)

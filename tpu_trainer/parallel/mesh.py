@@ -42,7 +42,10 @@ FSDP_AXIS = "fsdp"
 SEQUENCE_AXIS = "sequence"
 TENSOR_AXIS = "tensor"
 EXPERT_AXIS = "expert"
-MESH_AXES = (DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, EXPERT_AXIS)
+STAGE_AXIS = "stage"
+MESH_AXES = (
+    DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, EXPERT_AXIS, STAGE_AXIS,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,9 +60,11 @@ class MeshConfig:
     sequence: int = 1
     tensor: int = 1
     expert: int = 1
+    stage: int = 1
 
     def resolve(self, n_devices: int) -> tuple:
-        sizes = [self.data, self.fsdp, self.sequence, self.tensor, self.expert]
+        sizes = [self.data, self.fsdp, self.sequence, self.tensor,
+                 self.expert, self.stage]
         n_auto = sum(1 for s in sizes if s == -1)
         if n_auto > 1:
             raise ValueError("at most one mesh axis may be -1")

@@ -3,7 +3,7 @@
 Pipeline parallelism is an aspirational bullet in the reference
 (``README.md:10`` — never implemented; SURVEY.md §2). Here it is a working
 SPMD schedule, built the TPU way: no per-stage processes or RPC — one
-``shard_map`` over a ``stage`` mesh axis, with activations handed to the
+``shard_map`` over the ``stage`` mesh axis, with activations handed to the
 next stage by ``lax.ppermute`` over ICI and the whole schedule expressed as
 a ``lax.scan`` (so it jits once and differentiates end-to-end; the backward
 pass is the reverse pipeline, derived by AD).
@@ -12,37 +12,49 @@ Schedule (classic GPipe):
 
 - The layer stack ``[L, ...]`` is split into ``S`` contiguous stages
   (``L/S`` layers each — the stacked-parameter layout from ``nn.scan`` makes
-  this a pure sharding of the leading axis).
-- The batch is split into ``M`` microbatches. At step ``t`` of ``M+S-1``,
-  stage ``s`` processes microbatch ``t - s`` (bubble fraction
-  ``(S-1)/(M+S-1)``).
-- Stage 0 feeds from the microbatch queue; stage ``S-1`` writes results.
-  Between steps every stage ppermutes its output to its right neighbor.
+  this a pure sharding of the leading axis; ``parallel/sharding.py`` pins
+  that dim to ``stage``).
+- The batch is split into ``M`` microbatches *by striding* (row ``j*M + m``
+  → microbatch ``m``): under a ``data``-sharded batch this keeps every
+  microbatch evenly spread across data shards, where a contiguous split
+  would put each microbatch on a subset of them.
+- At step ``t`` of ``M+S-1``, stage ``s`` processes microbatch ``t - s``
+  (bubble fraction ``(S-1)/(M+S-1)``). Stage 0 feeds from the microbatch
+  queue; stage ``S-1`` stores results; between steps every stage ppermutes
+  its output to its right neighbor.
 
-`pipeline_forward` is deliberately model-agnostic: it takes the stacked
-per-layer params and a ``block_fn(layer_params, x) -> x``. The embedding /
-final-norm / loss stay outside (they are cheap and replicated).
+The shard_map is *partial-manual* (``axis_names={stage}``): every other
+mesh axis stays under GSPMD, so the batch's ``data`` sharding and the
+params' ``fsdp``/``tensor`` shardings ride through untouched and the
+schedule composes with DP/ZeRO by construction.
+
+``pipeline_forward`` is deliberately model-agnostic: it takes the stacked
+per-layer params and a ``block_fn(layer_params, x[, rng]) -> x``. The
+embedding / final-norm / loss stay outside (they are cheap and replicated
+over ``stage``). With ``rng`` given, ``block_fn`` receives a key folded per
+(global layer, microbatch) — distinct dropout masks everywhere.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-STAGE_AXIS = "stage"
+from tpu_trainer.parallel.mesh import STAGE_AXIS
 
 
 def pipeline_forward(
     stacked_params: Any,
     x: jax.Array,
-    block_fn: Callable[[Any, jax.Array], jax.Array],
+    block_fn: Callable,
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = STAGE_AXIS,
+    rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Run ``x`` through the full layer stack with a GPipe schedule.
 
@@ -51,36 +63,53 @@ def pipeline_forward(
         (the ``nn.scan`` layout); logically global, sharded over ``axis_name``.
       x: ``[batch, seq, hidden]`` activations; batch must divide into
         ``num_microbatches``.
-      block_fn: applies ONE layer: ``block_fn(params_of_layer, x) -> x``.
-      mesh: mesh containing ``axis_name``.
+      block_fn: applies ONE layer: ``block_fn(params_of_layer, x) -> x``, or
+        ``block_fn(params_of_layer, x, rng) -> x`` when ``rng`` is given.
+      mesh: mesh containing ``axis_name`` (other axes stay GSPMD-auto).
       num_microbatches: M; more microbatches -> smaller pipeline bubble.
+      rng: optional dropout key; folded per (global layer, microbatch).
 
     Returns activations after all L layers, ``[batch, seq, hidden]``.
     """
     S = mesh.shape[axis_name]
     b, s, h = x.shape
-    if b % num_microbatches != 0:
-        raise ValueError(f"batch {b} not divisible by M={num_microbatches}")
+    M = num_microbatches
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by M={M}")
     n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
     if n_layers % S != 0:
         raise ValueError(
             f"num_layers {n_layers} not divisible by {S} pipeline stages"
         )
-    mb = b // num_microbatches
-    M = num_microbatches
+    mb = b // M
+    layers_per_stage = n_layers // S
 
-    def staged(local_params, x_local):
+    def staged(local_params, x_local, *rng_arg):
         # local_params: leaves [L/S, ...] (this stage's layers).
-        # x_local: full batch [b, s, h] (batch stays replicated over the
-        # stage axis; only the *stage* of processing differs).
+        # x_local: full batch [b, s, h], replicated over `stage` (its data
+        # sharding, if any, is handled by the surrounding auto axes).
         stage = lax.axis_index(axis_name)
-        micro = x_local.reshape(M, mb, s, h)
+        # Strided microbatching: row j*M + m -> microbatch m (see module
+        # docstring for why not contiguous).
+        micro = x_local.reshape(mb, M, s, h).transpose(1, 0, 2, 3)
 
-        def run_stage(xm):
-            def one_layer(carry, layer_params):
-                return block_fn(layer_params, carry), None
+        def run_stage(xm, t):
+            micro_idx = t - stage  # valid in [0, M) when the step is real
 
-            out, _ = lax.scan(one_layer, xm, local_params)
+            def one_layer(carry, scanned):
+                li, p = scanned
+                if rng_arg:
+                    g_layer = stage * layers_per_stage + li
+                    r = jax.random.fold_in(
+                        rng_arg[0], g_layer * M + jnp.clip(micro_idx, 0, M - 1)
+                    )
+                    return block_fn(p, carry, r), None
+                return block_fn(p, carry), None
+
+            out, _ = lax.scan(
+                one_layer, xm,
+                (jnp.arange(layers_per_stage), local_params),
+            )
             return out
 
         perm = [(i, (i + 1) % S) for i in range(S)]
@@ -94,7 +123,7 @@ def pipeline_forward(
             # activation that arrived from the left neighbor.
             feed_idx = jnp.clip(t, 0, M - 1)
             x_in = jnp.where(stage == 0, micro[feed_idx], moving)
-            y = run_stage(x_in)
+            y = run_stage(x_in, t)
             # Last stage stores microbatch t - (S-1) when it's real.
             out_idx = t - (S - 1)
             store = jnp.logical_and(stage == S - 1, out_idx >= 0)
@@ -117,16 +146,20 @@ def pipeline_forward(
         # one-hot-masked buffer).
         mask = (stage == S - 1).astype(outputs.dtype)
         outputs = lax.psum(outputs * mask, axis_name)
-        return outputs.reshape(b, s, h)
+        # Undo the strided microbatch grouping.
+        return outputs.transpose(1, 0, 2, 3).reshape(b, s, h)
 
     layer_specs = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
     )
+    rng_args = () if rng is None else (rng,)
+    rng_specs = () if rng is None else (P(),)
     fn = shard_map(
         staged,
         mesh=mesh,
-        in_specs=(layer_specs, P()),
+        in_specs=(layer_specs, P()) + rng_specs,
         out_specs=P(),
+        axis_names={axis_name},
         check_vma=False,
     )
-    return fn(stacked_params, x)
+    return fn(stacked_params, x, *rng_args)

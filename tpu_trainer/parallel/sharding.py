@@ -47,7 +47,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_trainer.parallel.mesh import (
-    DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, TENSOR_AXIS,
+    DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, STAGE_AXIS, TENSOR_AXIS,
 )
 
 # Strategy names: ours (zero3/zero2/replicated) with the reference's
@@ -131,13 +131,24 @@ def fsdp_spec(shape, fsdp_size: int) -> P:
 
 
 def _leaf_spec(path_keys, shape, *, fsdp_size: int, tensor_size: int,
-               shard_fsdp: bool, expert_size: int = 1) -> P:
-    """Combined EP + TP + FSDP PartitionSpec for one array leaf."""
+               shard_fsdp: bool, expert_size: int = 1,
+               stage_size: int = 1) -> P:
+    """Combined PP + EP + TP + FSDP PartitionSpec for one array leaf."""
     if not shape:
         return P()
     dims: List[Optional[str]] = [None] * len(shape)
+    if (
+        stage_size > 1
+        and "layers" in path_keys
+        and shape
+        and shape[0] % stage_size == 0
+    ):
+        # Pipeline parallelism: the nn.scan stacked [num_layers, ...]
+        # leading dim splits into contiguous stages. Everything outside the
+        # layer stack (embedding, final norm) replicates over `stage`.
+        dims[0] = STAGE_AXIS
     edim = _expert_dim(path_keys, shape, expert_size)
-    if edim is not None:
+    if edim is not None and dims[edim] is None:
         dims[edim] = EXPERT_AXIS
     tdim = _tensor_dim(path_keys, shape, tensor_size)
     if tdim is not None and dims[tdim] is None:
@@ -159,11 +170,13 @@ def _specs_for_tree(tree: Any, mesh: Mesh, *, shard_fsdp: bool) -> Any:
     fsdp_size = mesh.shape[FSDP_AXIS]
     tensor_size = mesh.shape[TENSOR_AXIS]
     expert_size = mesh.shape.get(EXPERT_AXIS, 1)
+    stage_size = mesh.shape.get(STAGE_AXIS, 1)
     return jax.tree_util.tree_map_with_path(
         lambda path, x: _leaf_spec(
             _path_keys(path), getattr(x, "shape", ()),
             fsdp_size=fsdp_size, tensor_size=tensor_size,
             shard_fsdp=shard_fsdp, expert_size=expert_size,
+            stage_size=stage_size,
         ),
         tree,
     )

@@ -121,6 +121,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--mesh_tensor", type=int, default=None)
     p.add_argument("--mesh_expert", type=int, default=None,
                    help="expert-parallel axis size (MoE models)")
+    p.add_argument("--mesh_stage", type=int, default=None,
+                   help="pipeline-parallel stage axis size (GPipe schedule)")
+    p.add_argument("--pipeline_microbatches", type=int, default=None,
+                   help="GPipe microbatches per step (default: one per stage)")
     p.add_argument("--num_experts", type=int, default=None,
                    help="> 0 turns every block's FFN into a routed MoE")
     p.add_argument("--multihost", action="store_true", default=None,
@@ -228,6 +232,8 @@ def resolve_configs(args, mode: str):
         overrides["use_flash_attention"] = False
     elif "use_flash_attention" not in overrides:
         overrides["use_flash_attention"] = True
+    if args.pipeline_microbatches is not None:
+        overrides["pipeline_microbatches"] = args.pipeline_microbatches
     model_config = dataclasses.replace(model_config, **overrides)
 
     # --- training ------------------------------------------------------
@@ -289,6 +295,7 @@ def resolve_configs(args, mode: str):
         sequence=_pick(args.mesh_sequence, default_mesh.sequence),
         tensor=_pick(args.mesh_tensor, default_mesh.tensor),
         expert=_pick(args.mesh_expert, 1),
+        stage=_pick(args.mesh_stage, 1),
     )
     parallel_config = ParallelConfig(
         mesh=mesh_config, sharding_strategy=strategy, cpu_offload=cpu_offload

@@ -149,6 +149,35 @@ class Trainer:
                     f"num_heads {self.model_config.num_heads} not divisible "
                     f"by tensor axis size {self.tp_size}"
                 )
+        self.stage_size = self.mesh.shape.get(mesh_lib.STAGE_AXIS, 1)
+        if self.stage_size > 1:
+            # Pipeline parallelism (parallel/pipeline.py): contiguous layer
+            # blocks per stage, GPipe microbatches within each step.
+            if self.model_config.num_layers % self.stage_size != 0:
+                raise ValueError(
+                    f"num_layers {self.model_config.num_layers} not divisible "
+                    f"by stage axis size {self.stage_size}"
+                )
+            if self.model_config.num_experts > 0:
+                raise NotImplementedError(
+                    "pipeline parallelism does not compose with MoE yet "
+                    "(the load-balance aux does not flow through the stage "
+                    "schedule)"
+                )
+            if self.sp_size > 1:
+                raise NotImplementedError(
+                    "pipeline parallelism does not compose with sequence "
+                    "parallelism yet (ring attention inside a stage body "
+                    "would nest manual shard_map regions)"
+                )
+            microbatches = (self.model_config.pipeline_microbatches
+                            or self.stage_size)
+            if training_config.batch_size % microbatches != 0:
+                raise ValueError(
+                    f"batch_size {training_config.batch_size} (rows per data "
+                    f"shard) not divisible by pipeline_microbatches "
+                    f"{microbatches}"
+                )
         self.model = GPT(self.model_config)
         self.optimizer = make_optimizer(training_config)
 
