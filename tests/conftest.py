@@ -25,3 +25,26 @@ jax.config.update("jax_threefry_partitionable", True)
 
 def pytest_report_header(config):
     return f"jax devices: {jax.device_count()} x {jax.devices()[0].platform}"
+
+
+# Fast/slow lanes (VERDICT r1 weak #9: the full suite is ~15-20 min; CI and
+# the inner loop need a <60s smoke subset). Modules whose tests compile
+# multi-device meshes, run interpret-mode Pallas kernels, or train many
+# steps are marked `slow` wholesale; `pytest -m fast` runs the remainder
+# (pure-function math, data pipeline, harness logic, logging).
+_SLOW_MODULES = {
+    "test_checkpoint", "test_cli", "test_decode", "test_distributed",
+    "test_flash", "test_infer", "test_model", "test_moe", "test_offload",
+    "test_pipeline", "test_ring", "test_tensor_parallel", "test_trainer",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        module = item.module.__name__.rsplit(".", 1)[-1]
+        if module in _SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+        else:
+            item.add_marker(pytest.mark.fast)

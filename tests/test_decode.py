@@ -12,7 +12,9 @@ import numpy as np
 import pytest
 
 from tpu_trainer.models.config import GPTConfig
-from tpu_trainer.models.gpt import GPT, generate, generate_kv, init_cache
+from tpu_trainer.models.gpt import (
+    GPT, generate, generate_bucketed, generate_kv, init_cache,
+)
 
 CFG = GPTConfig(
     vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
@@ -98,3 +100,45 @@ class TestGenerateKV:
                 params, jax.random.PRNGKey(0), ids, config=CFG,
                 max_new_tokens=10,
             )
+
+
+class TestBucketedGenerate:
+    """Bucketed compile shapes (VERDICT r1 weak #7): prompts of different
+    lengths share one XLA compile, with unchanged sampling semantics."""
+
+    def test_greedy_matches_exact_shapes(self, params):
+        for plen in (5, 11, 16):
+            ids = jax.random.randint(
+                jax.random.PRNGKey(plen), (1, plen), 0, CFG.vocab_size
+            )
+            exact = generate(params, jax.random.PRNGKey(1), ids, config=CFG,
+                             max_new_tokens=6, top_k=1)
+            bucketed = generate_bucketed(
+                params, jax.random.PRNGKey(1), ids, config=CFG,
+                max_new_tokens=6, top_k=1,
+            )
+            assert bucketed.shape == (1, plen + 6)
+            np.testing.assert_array_equal(np.asarray(bucketed),
+                                          np.asarray(exact))
+
+    def test_second_prompt_length_reuses_compile(self, params):
+        # Three prompt lengths inside the same 16-bucket -> at most one new
+        # compile of the underlying jitted generate (zero when another test
+        # already populated the bucket), never one per length.
+        before = generate._cache_size()
+        for plen in (5, 9, 13):
+            ids = jnp.ones((1, plen), jnp.int32)
+            generate_bucketed(params, jax.random.PRNGKey(0), ids, config=CFG,
+                              max_new_tokens=4, top_k=1)
+        assert generate._cache_size() - before <= 1
+
+    def test_overflow_bucket_falls_back_to_exact(self, params):
+        # true 60 + 4 == max_seq_len 64 fits, but the 64-bucket + 4 would
+        # overflow: must fall back to exact shapes, same semantics.
+        ids = jax.random.randint(jax.random.PRNGKey(3), (1, 60), 0,
+                                 CFG.vocab_size)
+        exact = generate(params, jax.random.PRNGKey(1), ids, config=CFG,
+                         max_new_tokens=4, top_k=1)
+        bucketed = generate_bucketed(params, jax.random.PRNGKey(1), ids,
+                                     config=CFG, max_new_tokens=4, top_k=1)
+        np.testing.assert_array_equal(np.asarray(bucketed), np.asarray(exact))

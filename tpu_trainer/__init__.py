@@ -16,9 +16,12 @@ numbers.
 __version__ = "0.1.0"
 
 from tpu_trainer.models.config import GPTConfig
-from tpu_trainer.models.gpt import GPT, count_parameters, generate, generate_kv
+from tpu_trainer.models.gpt import (
+    GPT, count_parameters, generate, generate_bucketed, generate_kv,
+)
 
 __all__ = [
-    "GPTConfig", "GPT", "count_parameters", "generate", "generate_kv",
+    "GPTConfig", "GPT", "count_parameters", "generate",
+    "generate_bucketed", "generate_kv",
     "__version__",
 ]

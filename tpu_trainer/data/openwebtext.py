@@ -30,6 +30,8 @@ def create_openwebtext_dataloader(
     num_workers: int = 0,
     prefetch: int = 2,
     tokenizer_on_fallback: str = "warn",
+    eval_split: float = 0.0,
+    eval_holdout_every: int = 0,
 ) -> TextDataLoader:
     """Reference-parity factory (``openwebtext.py:133-181``): ``batch_size``
     is rows per host; yields ``[batch_size, seq_len]`` int32 batches."""
@@ -47,4 +49,6 @@ def create_openwebtext_dataloader(
         num_workers=num_workers,
         prefetch=prefetch,
         tokenizer_on_fallback=tokenizer_on_fallback,
+        eval_split=eval_split,
+        eval_holdout_every=eval_holdout_every,
     )

@@ -194,6 +194,27 @@ class TextDataset:
         return self.chunks[i]
 
 
+class ChunkSubset:
+    """Contiguous index-range view over a map-style dataset's chunks — the
+    held-out split mechanism (train = head, eval = tail; see
+    ``create_text_dataloader(eval_split=...)``)."""
+
+    def __init__(self, dataset, start: int, stop: int):
+        if not (0 <= start <= stop <= len(dataset)):
+            raise ValueError(f"bad subset [{start}, {stop}) of {len(dataset)}")
+        self.dataset = dataset
+        self.start = start
+        self.stop = stop
+
+    def __len__(self) -> int:
+        return self.stop - self.start
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return self.dataset[self.start + i]
+
+
 class StreamingTextDataset:
     """Iterable: line-modulo sharded streaming with a rolling token buffer
     (reference ``tinystories.py:53-119``, ``openwebtext.py:95-130``).
@@ -218,7 +239,16 @@ class StreamingTextDataset:
         num_shards: int = 1,
         num_workers: int = 0,
         tokenizer_on_fallback: str = "warn",
+        holdout=None,
     ):
+        """``holdout=(role, N)`` carves an eval split out of the stream:
+        every N-th line *of each host's shard* (``(line_idx // num_shards)
+        % N == N - 1``) belongs to eval. ``role="train"`` skips those
+        lines; ``role="eval"`` yields only them. Keying the filter on the
+        within-shard position (not the raw index) keeps it decorrelated
+        from host sharding — with ``line_idx % N`` a shared factor between
+        N and the host count would give some hosts an empty stream (and a
+        multihost run a collective deadlock)."""
         self.path = resolve_path(path)
         self.seq_len = seq_len
         self.tokenizer = get_tokenizer(
@@ -228,14 +258,27 @@ class StreamingTextDataset:
         self.shard_id = shard_id
         self.num_shards = num_shards
         self.num_workers = num_workers
+        if holdout is not None:
+            role, every = holdout
+            if role not in ("train", "eval") or every < 2:
+                raise ValueError(f"bad holdout {holdout!r}")
+        self.holdout = holdout
         self.cache = LRUTokenCache(cache_max_tokens)
 
     def _encode(self, line: str) -> List[int]:
         return self.tokenizer.encode(line) + [self.tokenizer.eos_token_id]
 
     def _sharded_lines(self, f) -> Iterator[tuple]:
-        """(line_idx, stripped line) pairs belonging to this shard."""
+        """(line_idx, stripped line) pairs belonging to this shard (and to
+        this dataset's side of the train/eval holdout, if any)."""
+        role, every = self.holdout if self.holdout else (None, 0)
         for line_idx, line in enumerate(f):
+            if role is not None:
+                is_eval_line = (
+                    (line_idx // self.num_shards) % every == every - 1
+                )
+                if is_eval_line == (role == "train"):
+                    continue
             if line_idx % self.num_shards != self.shard_id:
                 continue
             line = line.strip()
@@ -423,32 +466,76 @@ def create_text_dataloader(
     num_workers: int = 0,
     prefetch: int = 2,
     tokenizer_on_fallback: str = "warn",
+    eval_split: float = 0.0,
+    eval_holdout_every: int = 0,
 ) -> TextDataLoader:
     """Factory shared by the dataset-specific wrappers (reference factory
     signatures: ``tinystories.py:122-134``, ``openwebtext.py:133-145``).
     ``num_workers`` parallelizes tokenization (streaming and map-style);
     ``prefetch`` overlaps batch assembly with device steps (0 disables).
     ``tokenizer_on_fallback="error"`` is the training guardrail: no silent
-    byte-level fallback (utils/tokenizer.py)."""
+    byte-level fallback (utils/tokenizer.py).
+
+    Held-out eval (the loop the reference's dead ``eval_interval`` promised,
+    ``ddp_trainer.py:52``): ``eval_split > 0`` (map-style) carves the last
+    ``eval_split`` fraction of chunks; ``eval_holdout_every = N > 0``
+    (streaming) reserves every N-th line. Either attaches an ``eval_loader``
+    (batching over the held-out rows only, prefetch off) to the returned
+    train loader; train and eval rows are disjoint by construction. The
+    attribute is None when no split is requested.
+    """
+    eval_loader = None
     if streaming:
-        dataset = StreamingTextDataset(
-            path,
-            seq_len,
+        holdout = ("train", eval_holdout_every) if eval_holdout_every else None
+        common = dict(
             tokenizer_name=tokenizer_name,
             max_tokens=max_tokens,
             cache_max_tokens=cache_max_tokens,
             shard_id=process_index,
             num_shards=process_count,
-            num_workers=num_workers,
             tokenizer_on_fallback=tokenizer_on_fallback,
         )
+        dataset = StreamingTextDataset(
+            path, seq_len, num_workers=num_workers, holdout=holdout, **common
+        )
+        if eval_holdout_every:
+            eval_ds = StreamingTextDataset(
+                path, seq_len, holdout=("eval", eval_holdout_every), **common
+            )
+            eval_loader = TextDataLoader(
+                eval_ds, batch_size,
+                process_index=process_index, process_count=process_count,
+                seed=seed, prefetch=0,
+            )
     else:
-        dataset = TextDataset(
+        full = TextDataset(
             path, seq_len, tokenizer_name=tokenizer_name,
             max_tokens=max_tokens, num_workers=num_workers,
             tokenizer_on_fallback=tokenizer_on_fallback,
         )
-    return TextDataLoader(
+        dataset = full
+        if eval_split > 0.0:
+            n = len(full)
+            n_eval = max(1, int(n * eval_split))
+            if n - n_eval < 1:
+                # Too small to split (eval_split defaults on): degrade to
+                # no-eval with a warning rather than refusing a tiny corpus
+                # that would previously train.
+                import warnings
+
+                warnings.warn(
+                    f"{path}: {n} chunk(s) cannot hold out eval_split="
+                    f"{eval_split} and still train; continuing without an "
+                    f"eval split"
+                )
+            else:
+                dataset = ChunkSubset(full, 0, n - n_eval)
+                eval_loader = TextDataLoader(
+                    ChunkSubset(full, n - n_eval, n), batch_size,
+                    process_index=process_index, process_count=process_count,
+                    seed=seed, prefetch=0,
+                )
+    loader = TextDataLoader(
         dataset,
         batch_size,
         process_index=process_index,
@@ -456,3 +543,5 @@ def create_text_dataloader(
         seed=seed,
         prefetch=prefetch,
     )
+    loader.eval_loader = eval_loader
+    return loader

@@ -28,6 +28,8 @@ def create_tinystories_dataloader(
     num_workers: int = 0,
     prefetch: int = 2,
     tokenizer_on_fallback: str = "warn",
+    eval_split: float = 0.0,
+    eval_holdout_every: int = 0,
 ) -> TextDataLoader:
     """Reference-parity factory (``tinystories.py:122-161``): ``batch_size``
     is rows per host; yields ``[batch_size, seq_len]`` int32 batches."""
@@ -45,4 +47,6 @@ def create_tinystories_dataloader(
         num_workers=num_workers,
         prefetch=prefetch,
         tokenizer_on_fallback=tokenizer_on_fallback,
+        eval_split=eval_split,
+        eval_holdout_every=eval_holdout_every,
     )

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_trainer.models.config import GPTConfig
-from tpu_trainer.models.gpt import generate, generate_kv
+from tpu_trainer.models.gpt import generate_bucketed, generate_kv
 from tpu_trainer.utils.checkpoint import latest_checkpoint, restore_params
 from tpu_trainer.utils.tokenizer import get_tokenizer
 
@@ -90,7 +90,9 @@ def main(argv=None) -> int:
     # KV-cached decode (O(S) per token) when the result fits the cache;
     # the windowed full-forward path handles overflow and --no_kv_cache.
     fits = input_ids.shape[1] + args.max_new_tokens <= config.max_seq_len
-    sampler = generate_kv if (fits and not args.no_kv_cache) else generate
+    # The fallback path buckets its compile shapes: repeated prompts of
+    # different lengths share one XLA compile (models/gpt.py).
+    sampler = generate_kv if (fits and not args.no_kv_cache) else generate_bucketed
     out = sampler(
         params,
         jax.random.PRNGKey(args.seed),

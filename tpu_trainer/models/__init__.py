@@ -8,6 +8,7 @@ from tpu_trainer.models.gpt import (
     apply_rotary_pos_emb,
     count_parameters,
     generate,
+    generate_bucketed,
     generate_kv,
     rope_tables,
     rotate_half,
@@ -24,6 +25,7 @@ __all__ = [
     "apply_rotary_pos_emb",
     "count_parameters",
     "generate",
+    "generate_bucketed",
     "generate_kv",
     "rope_tables",
     "rotate_half",

@@ -482,6 +482,8 @@ def generate(
     max_new_tokens: int = 100,
     temperature: float = 1.0,
     top_k: int = 50,
+    prompt_len: Optional[jax.Array] = None,
+    num_new: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Autoregressive sampling (reference ``gpt.py:457-484``), fully jitted.
 
@@ -489,16 +491,26 @@ def generate(
     logits by ``temperature``, keep the top-k logits (when ``top_k > 0``),
     sample from the resulting distribution, append. The reference's Python
     loop with a growing tensor becomes a fixed-size buffer + ``lax.fori_loop``
-    (static shapes; one compile per (prompt_len, max_new_tokens)).
+    (static shapes; one compile per (input width, max_new_tokens)).
+
+    ``prompt_len`` (a traced int scalar, <= the input width) makes the input
+    width a *bucket* rather than the semantic prompt length: generation
+    starts at ``prompt_len`` and padding beyond it is never attended (causal
+    masking makes positions >= the current index invisible). ``num_new``
+    (traced, <= ``max_new_tokens``) likewise makes the new-token count a
+    bucket: the loop runs only the requested steps. Together these let
+    ``generate_bucketed`` reuse one compile across prompt lengths and
+    new-token counts without executing padded decode steps.
 
     The reference recomputes the full forward each step with no KV cache
     (``infer.py`` hot loop, SURVEY.md §3.5); a windowed full forward matches
-    that exactly. KV-cached decode is a planned fast path.
+    that exactly. ``generate_kv`` is the cached fast path.
     """
     model = GPT(config)
-    b, prompt_len = input_ids.shape
-    total = prompt_len + max_new_tokens
+    b, width = input_ids.shape
+    total = width + max_new_tokens
     window = min(total, config.max_seq_len)
+    start_i = width if prompt_len is None else prompt_len
 
     buf = jnp.zeros((b, total), dtype=input_ids.dtype)
     buf = jax.lax.dynamic_update_slice(buf, input_ids, (0, 0))
@@ -516,8 +528,64 @@ def generate(
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, i))
         return buf, rng
 
-    buf, _ = jax.lax.fori_loop(prompt_len, total, body, (buf, rng))
+    n_new = max_new_tokens if num_new is None else num_new
+    buf, _ = jax.lax.fori_loop(start_i, start_i + n_new, body, (buf, rng))
     return buf
+
+
+def _bucket(n: int, floor: int = 16) -> int:
+    """Next power of two >= n (>= floor)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def generate_bucketed(
+    params,
+    rng: jax.Array,
+    input_ids: jax.Array,
+    *,
+    config: GPTConfig,
+    max_new_tokens: int = 100,
+    temperature: float = 1.0,
+    top_k: int = 50,
+) -> jax.Array:
+    """``generate`` with bucketed compile shapes (VERDICT r1 weak #7).
+
+    The jitted ``generate`` compiles once per (input width, max_new_tokens)
+    pair — every new prompt length used to pay a full XLA compile. Here the
+    prompt pads up to a power-of-two bucket and the new-token count rounds
+    up likewise, with the true ``prompt_len`` passed as a *traced* scalar:
+    any prompt in the same bucket reuses the compile, and the result is
+    sliced back to exactly ``prompt + max_new_tokens``. Sampling semantics
+    are identical (padding is never attended; the sampling loop runs the
+    same positions with the same key folds).
+    """
+    b, true_len = input_ids.shape
+    width = _bucket(true_len)
+    new_bucket = _bucket(max_new_tokens)
+    if (width + new_bucket > config.max_seq_len
+            >= true_len + max_new_tokens) or width > config.max_seq_len:
+        # Bucket rounding would engage the context-window crop earlier than
+        # the exact shapes do (window = min(total, max_seq_len)); keep exact
+        # reference semantics and pay the compile.
+        return generate(
+            params, rng, input_ids,
+            config=config, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k,
+        )
+    padded = jnp.zeros((b, width), input_ids.dtype)
+    padded = jax.lax.dynamic_update_slice(padded, input_ids, (0, 0))
+    buf = generate(
+        params, rng, padded,
+        config=config, max_new_tokens=new_bucket, temperature=temperature,
+        top_k=top_k, prompt_len=jnp.asarray(true_len, jnp.int32),
+        num_new=jnp.asarray(max_new_tokens, jnp.int32),
+    )
+    return jax.lax.dynamic_slice(
+        buf, (0, 0), (b, true_len + max_new_tokens)
+    )
 
 
 def init_cache(config: GPTConfig, batch_size: int):
@@ -565,6 +633,16 @@ def generate_kv(
     reference's O(S^2) full re-forward (``infer.py`` hot loop, SURVEY.md
     §3.5). Requires ``prompt_len + max_new_tokens <= config.max_seq_len``
     (the cache size); ``generate`` handles the windowed overflow case.
+
+    Prompts in a batch must all be real (uniform) length: the cache keeps
+    one running position shared across the batch and the decode attention
+    has no padding mask, so a ragged batch padded to a common width would
+    silently attend to the pad tokens. Batch rows of different lengths
+    belong in separate calls (or use ``generate``/``generate_bucketed``,
+    whose causal window never sees positions past each row's write
+    frontier... the same frontier for all rows — i.e. uniform-length there
+    too; true per-row raggedness needs per-row masks that neither path
+    implements, matching the reference's batch-of-one generator).
     """
     model = GPT(config)
     b, prompt_len = input_ids.shape

@@ -97,11 +97,21 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--log_interval", type=int, default=None)
     p.add_argument("--eval_interval", type=int, default=None)
     p.add_argument("--eval_batches", type=int, default=None)
+    p.add_argument("--eval_split", type=float, default=None,
+                   help="held-out tail fraction of map-style text chunks "
+                        "(default 0.02; 0 disables eval on text datasets)")
+    p.add_argument("--eval_holdout_every", type=int, default=None,
+                   help="streaming: reserve every N-th line for eval "
+                        "(0 = no streaming eval)")
     p.add_argument("--save_interval", type=int, default=None)
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--resume_from", type=str, default=None)
     p.add_argument("--no_auto_resume", action="store_true", default=None)
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--wandb_project", type=str, default=None,
+                   help="log metrics to Weights & Biases (import-guarded)")
+    p.add_argument("--tensorboard_dir", type=str, default=None,
+                   help="log metrics to TensorBoard event files")
     p.add_argument("--seed", type=int, default=None)
     # profiling (SURVEY.md §5.1) and numerics/divergence guards (§5.2)
     p.add_argument("--profile_dir", type=str, default=None,
@@ -313,7 +323,12 @@ def resolve_configs(args, mode: str):
         "num_batches": _pick(args.num_batches, 100),
         "tokenizer": _pick(args.tokenizer, y_data.get("tokenizer"), "gpt2"),
         "metrics_jsonl": args.metrics_jsonl,
+        "wandb_project": args.wandb_project,
+        "tensorboard_dir": args.tensorboard_dir,
         "eval_batches": _pick(args.eval_batches, 8),
+        "eval_split": _pick(args.eval_split, y_data.get("eval_split"), 0.02),
+        "eval_holdout_every": _pick(args.eval_holdout_every,
+                                    y_data.get("eval_holdout_every"), 0),
         "auto_resume": not args.no_auto_resume,
         "profile_dir": args.profile_dir,
         "profile_start": _pick(args.profile_start, 5),
@@ -379,27 +394,15 @@ def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
         # Tokenizer guardrail (VERDICT r1 weak #6): training never falls
         # back to byte-level ids silently — choose it as --tokenizer byte.
         tokenizer_on_fallback="error",
+        # Held-out eval (VERDICT r1 weak #5: the old "eval" re-read the
+        # training data): map-style carves the tail eval_split fraction of
+        # chunks; streaming reserves every eval_holdout_every-th line.
+        # Train/eval rows are disjoint by construction (data/text.py).
+        eval_split=0.0 if data_opts["streaming"] else data_opts["eval_split"],
+        eval_holdout_every=(data_opts["eval_holdout_every"]
+                            if data_opts["streaming"] else 0),
     )
-    # Text eval: smoke-eval on a deterministic re-pass of the data (held-out
-    # splits are the user's responsibility, as in the reference which has no
-    # eval at all). A separate loader over the same chunk matrix keeps the
-    # training loader's epoch/shuffle state untouched. Streaming datasets
-    # skip eval.
-    if data_opts["streaming"]:
-        eval_loader = None
-    else:
-        from tpu_trainer.data.text import TextDataLoader
-
-        eval_loader = TextDataLoader(
-            train.dataset, rows,
-            process_index=trainer.process_index,
-            process_count=trainer.process_count,
-            seed=train.seed,
-            # Eval passes are short and break early: no background thread
-            # (determinism > overlap for an 8-batch pass).
-            prefetch=0,
-        )
-    return train, eval_loader
+    return train, train.eval_loader
 
 
 def run_training(argv=None, mode: str = "ddp") -> int:
@@ -453,6 +456,12 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         log_interval=training_config.log_interval,
         jsonl_path=data_opts["metrics_jsonl"],
         is_main_process=main,
+        wandb_project=data_opts["wandb_project"],
+        tensorboard_dir=data_opts["tensorboard_dir"],
+        run_config={
+            "model": dataclasses.asdict(model_config),
+            "training": dataclasses.asdict(training_config),
+        },
     )
     logger.tokens_seen = tokens_seen
 
@@ -473,6 +482,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         if main:
             print(f"saved checkpoint{' (' + tag + ')' if tag else ''}: {path}")
 
+    eval_warned = {"hit": False}
+
     def run_eval():
         if eval_loader is None:
             return
@@ -482,8 +493,15 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 break
             losses.append(float(trainer.eval_step(state, batch)))
         if losses and main:
-            print(f"eval | step {int(state.step):>6d} | "
-                  f"loss {float(np.mean(losses)):.4f} ({len(losses)} batches)")
+            logger.log_eval(int(state.step), float(np.mean(losses)),
+                            len(losses))
+        elif not losses and main and not eval_warned["hit"]:
+            eval_warned["hit"] = True
+            print(
+                "eval | no full eval batch (held-out rows < batch rows x "
+                "hosts); grow --eval_split / --eval_holdout_every or the "
+                "dataset", flush=True,
+            )
 
     # --- the step loop (reference ddp_trainer.py:582-616) --------------
     data_iter = iter(train_loader)

@@ -105,6 +105,9 @@ class MetricLogger:
         jsonl_path: Optional[str] = None,
         stdout: bool = True,
         is_main_process: Optional[bool] = None,
+        wandb_project: Optional[str] = None,
+        tensorboard_dir: Optional[str] = None,
+        run_config: Optional[dict] = None,
     ):
         self.model_config = model_config
         self.tokens_per_step = tokens_per_step
@@ -117,6 +120,34 @@ class MetricLogger:
         if jsonl_path and self.is_main:
             os.makedirs(os.path.dirname(os.path.abspath(jsonl_path)), exist_ok=True)
             self._jsonl = open(jsonl_path, "a", buffering=1)
+        # Optional sinks (declared deps / README milestones the reference
+        # never wired — requirements.txt:12-13, README.md:215; SURVEY.md
+        # §5.5). Import-guarded: a missing package degrades to a one-line
+        # warning, never a crash. Host 0 only, like every other sink.
+        self._wandb = None
+        if wandb_project and self.is_main:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(
+                    project=wandb_project, config=run_config or {}
+                )
+            except Exception as e:  # missing package, no login, offline...
+                import warnings
+
+                warnings.warn(f"wandb sink disabled: {type(e).__name__}: {e}")
+        self._tb = None
+        if tensorboard_dir and self.is_main:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb = SummaryWriter(tensorboard_dir)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"tensorboard sink disabled: {type(e).__name__}: {e}"
+                )
         self.tokens_seen = 0
         self._t0 = time.perf_counter()
         self._window_t = self._t0
@@ -168,9 +199,54 @@ class MetricLogger:
             print(" | ".join(parts), flush=True)
         if self._jsonl:
             self._jsonl.write(json.dumps(record) + "\n")
+        self._emit_scalars(record["step"], {
+            k: v for k, v in record.items()
+            if isinstance(v, (int, float)) and k != "step"
+        }, prefix="train")
+        return record
+
+    def _emit_scalars(self, step: int, scalars: dict, prefix: str) -> None:
+        if self._wandb is not None:
+            self._wandb.log(
+                {f"{prefix}/{k}": v for k, v in scalars.items()}, step=step
+            )
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(f"{prefix}/{k}", v, step)
+
+    def log_eval(self, step: int, eval_loss: float, n_batches: int) -> dict:
+        """Held-out eval record: loss + perplexity (exp clamped against
+        overflow on early-training losses), written to the same sinks."""
+        import math
+
+        record = {
+            "kind": "eval",
+            "step": int(step),
+            "eval_loss": float(eval_loss),
+            "perplexity": round(math.exp(min(float(eval_loss), 30.0)), 4),
+            "eval_batches": int(n_batches),
+        }
+        if self.stdout:
+            print(
+                f"eval | step {record['step']:>6d} | "
+                f"loss {record['eval_loss']:.4f} | "
+                f"ppl {record['perplexity']:.2f} ({n_batches} batches)",
+                flush=True,
+            )
+        if self._jsonl:
+            self._jsonl.write(json.dumps(record) + "\n")
+        self._emit_scalars(record["step"], {
+            "loss": record["eval_loss"], "perplexity": record["perplexity"],
+        }, prefix="eval")
         return record
 
     def close(self) -> None:
         if self._jsonl:
             self._jsonl.close()
             self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+        if self._wandb is not None:
+            self._wandb.finish()
+            self._wandb = None
