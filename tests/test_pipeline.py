@@ -142,6 +142,19 @@ class TestPipelineAsStrategy:
         assert ddp == pytest.approx(pp4, rel=1e-5)
         assert ddp == pytest.approx(pp2_dp4, rel=1e-5)
 
+    def test_pipeline_composes_with_zero(self):
+        """The partial-manual stage shard_map leaves other axes GSPMD-auto,
+        so PP x ZeRO-2/3 must be pure placement: losses equal DDP."""
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        pp_z3 = self._run(MeshConfig(data=1, fsdp=4, stage=2), 2,
+                          strategy="zero3")
+        pp_z2 = self._run(MeshConfig(data=2, fsdp=2, stage=2), 2,
+                          strategy="zero2")
+        assert ddp == pytest.approx(pp_z3, rel=1e-5)
+        assert ddp == pytest.approx(pp_z2, rel=1e-5)
+
     def test_pipeline_with_accum_and_remat(self):
         import dataclasses as dc
 

@@ -13,7 +13,7 @@ component-by-component parity map and benchmarks/results.md for measured
 numbers.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in sync with pyproject.toml
 
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.models.gpt import (
