@@ -360,25 +360,21 @@ class GPT(nn.Module):
             # Pipeline parallelism: the stacked layers (sharded over `stage`
             # by parallel/sharding.py) run through the GPipe schedule
             # (parallel/pipeline.py). Embedding / final norm / loss stay
-            # outside, replicated over the stage axis. Dense models only
-            # (the Trainer validates); the MoE aux is therefore zero. The
+            # outside, replicated over the stage axis. The MoE aux rides the
+            # schedule (summed over layers, per-microbatch estimator). The
             # flash dispatch still shard_maps the kernel inside the stage
             # body — its manual region covers only batch/head axes, disjoint
             # from `stage` (ops/attention.py).
             from tpu_trainer.parallel.pipeline import pipeline_forward
 
             def block_fn(p, xm, rng=None):
-                out, _aux = run_block(
-                    p, (xm, jnp.zeros((), jnp.float32)), rng
-                )
-                return out
+                return run_block(p, (xm, jnp.zeros((), jnp.float32)), rng)
 
             rng = self.make_rng("dropout") if needs_rng else None
-            x = pipeline_forward(
+            x, moe_aux = pipeline_forward(
                 self.variables["params"]["layers"], x, block_fn, ctx_mesh,
-                cfg.pipeline_microbatches or stage_n, rng=rng,
+                cfg.pipeline_microbatches or stage_n, rng=rng, with_aux=True,
             )
-            moe_aux = jnp.zeros((), jnp.float32)
         elif manual_apply and cfg.scan_unroll:
             # Unrolled apply path: parameters keep the nn.scan layout
             # ([num_layers, ...] stacked leaves, created by the scan branch

@@ -242,6 +242,14 @@ def ring_attention(
             )
         return body(q, k, v, rng)
 
+    # Full-manual over the mesh (axes the specs don't mention are
+    # replicated). A partial-manual variant (axis_names restricted like the
+    # flash wrapper's) would be needed to nest the ring inside the pipeline
+    # stage body, but the ring's loop-carried ppermute trips Shardy's nested
+    # manual-region axis binding on jax 0.9 regardless, and partial mode
+    # forces check_vma=True, which would require vma annotations on the
+    # Pallas out_shapes — so SP x PP stays guarded off in the Trainer and
+    # the ring keeps the simple full-manual form.
     fn = shard_map(
         local,
         mesh=mesh,

@@ -55,7 +55,8 @@ def pipeline_forward(
     num_microbatches: int,
     axis_name: str = STAGE_AXIS,
     rng: Optional[jax.Array] = None,
-) -> jax.Array:
+    with_aux: bool = False,
+) -> Any:
     """Run ``x`` through the full layer stack with a GPipe schedule.
 
     Args:
@@ -65,11 +66,18 @@ def pipeline_forward(
         ``num_microbatches``.
       block_fn: applies ONE layer: ``block_fn(params_of_layer, x) -> x``, or
         ``block_fn(params_of_layer, x, rng) -> x`` when ``rng`` is given.
+        With ``with_aux``, returns ``(x, aux_scalar)`` instead (the MoE
+        load-balance term).
       mesh: mesh containing ``axis_name`` (other axes stay GSPMD-auto).
       num_microbatches: M; more microbatches -> smaller pipeline bubble.
       rng: optional dropout key; folded per (global layer, microbatch).
+      with_aux: accumulate per-layer scalar aux across real schedule steps
+        (bubble steps excluded), summed over layers and averaged over
+        microbatches — the per-micro estimator matching grad-accum
+        semantics. Returns ``(activations, aux)``.
 
-    Returns activations after all L layers, ``[batch, seq, hidden]``.
+    Returns activations after all L layers, ``[batch, seq, hidden]``
+    (plus the aux scalar when ``with_aux``).
     """
     S = mesh.shape[axis_name]
     b, s, h = x.shape
@@ -97,20 +105,29 @@ def pipeline_forward(
             micro_idx = t - stage  # valid in [0, M) when the step is real
 
             def one_layer(carry, scanned):
+                xc, aux = carry
                 li, p = scanned
+                args = (p, xc)
                 if rng_arg:
                     g_layer = stage * layers_per_stage + li
-                    r = jax.random.fold_in(
+                    args = args + (jax.random.fold_in(
                         rng_arg[0], g_layer * M + jnp.clip(micro_idx, 0, M - 1)
-                    )
-                    return block_fn(p, carry, r), None
-                return block_fn(p, carry), None
+                    ),)
+                out = block_fn(*args)
+                if with_aux:
+                    out, layer_aux = out
+                    aux = aux + layer_aux
+                return (out, aux), None
 
-            out, _ = lax.scan(
-                one_layer, xm,
+            (out, aux), _ = lax.scan(
+                one_layer, (xm, jnp.zeros((), jnp.float32)),
                 (jnp.arange(layers_per_stage), local_params),
             )
-            return out
+            # Bubble steps compute garbage that must not leak into the aux
+            # sum; micro_idx validity is decided here, next to where it is
+            # defined.
+            real = jnp.logical_and(micro_idx >= 0, micro_idx < M)
+            return out, jnp.where(real, aux, 0.0)
 
         perm = [(i, (i + 1) % S) for i in range(S)]
         outputs0 = jnp.zeros((M, mb, s, h), x_local.dtype)
@@ -118,12 +135,13 @@ def pipeline_forward(
         moving0 = jnp.zeros((mb, s, h), x_local.dtype)
 
         def step(carry, t):
-            moving, outputs = carry
+            moving, outputs, aux_acc = carry
             # Stage 0 ingests microbatch t (when in range); others take the
             # activation that arrived from the left neighbor.
             feed_idx = jnp.clip(t, 0, M - 1)
             x_in = jnp.where(stage == 0, micro[feed_idx], moving)
-            y = run_stage(x_in, t)
+            y, aux_y = run_stage(x_in, t)  # aux_y already bubble-masked
+            aux_acc = aux_acc + aux_y
             # Last stage stores microbatch t - (S-1) when it's real.
             out_idx = t - (S - 1)
             store = jnp.logical_and(stage == S - 1, out_idx >= 0)
@@ -136,10 +154,11 @@ def pipeline_forward(
                 outputs,
             )
             moving = lax.ppermute(y, axis_name, perm)
-            return (moving, outputs), None
+            return (moving, outputs, aux_acc), None
 
-        (_, outputs), _ = lax.scan(
-            step, (moving0, outputs0), jnp.arange(M + S - 1)
+        (_, outputs, aux_acc), _ = lax.scan(
+            step, (moving0, outputs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + S - 1),
         )
         # Only the last stage holds real outputs; broadcast them to every
         # stage so the result is replicated over the axis (psum of a
@@ -147,7 +166,12 @@ def pipeline_forward(
         mask = (stage == S - 1).astype(outputs.dtype)
         outputs = lax.psum(outputs * mask, axis_name)
         # Undo the strided microbatch grouping.
-        return outputs.transpose(1, 0, 2, 3).reshape(b, s, h)
+        outputs = outputs.transpose(1, 0, 2, 3).reshape(b, s, h)
+        if with_aux:
+            # Sum over stages = sum over all layers; mean over microbatches.
+            aux = lax.psum(aux_acc, axis_name) / M
+            return outputs, aux
+        return outputs
 
     layer_specs = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
@@ -158,7 +182,7 @@ def pipeline_forward(
         staged,
         mesh=mesh,
         in_specs=(layer_specs, P()) + rng_specs,
-        out_specs=P(),
+        out_specs=(P(), P()) if with_aux else P(),
         axis_names={axis_name},
         check_vma=False,
     )

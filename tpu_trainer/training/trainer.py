@@ -158,17 +158,14 @@ class Trainer:
                     f"num_layers {self.model_config.num_layers} not divisible "
                     f"by stage axis size {self.stage_size}"
                 )
-            if self.model_config.num_experts > 0:
-                raise NotImplementedError(
-                    "pipeline parallelism does not compose with MoE yet "
-                    "(the load-balance aux does not flow through the stage "
-                    "schedule)"
-                )
             if self.sp_size > 1:
                 raise NotImplementedError(
                     "pipeline parallelism does not compose with sequence "
-                    "parallelism yet (ring attention inside a stage body "
-                    "would nest manual shard_map regions)"
+                    "parallelism yet: the ring's loop-carried ppermute "
+                    "inside the stage body trips Shardy's nested "
+                    "manual-region axis binding (reproduced on jax 0.9; "
+                    "plain nested shard_map and non-loop collectives nest "
+                    "fine)"
                 )
             microbatches = (self.model_config.pipeline_microbatches
                             or self.stage_size)
