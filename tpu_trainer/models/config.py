@@ -48,6 +48,12 @@ class GPTConfig:
     hidden_size: int = 768
     num_layers: int = 12
     num_heads: int = 12
+    # Grouped-query attention (beyond-reference, LLaMA-2/3-style): number of
+    # K/V heads; None = num_heads (classic multi-head). Each group of
+    # num_heads // num_kv_heads query heads shares one K/V head — the KV
+    # cache, the k/v projections, and the ring-attention K/V traffic all
+    # shrink by the group factor.
+    num_kv_heads: Optional[int] = None
     intermediate_size: Optional[int] = None  # defaults to 4 * hidden_size
     max_seq_len: int = 1024
 
@@ -134,6 +140,15 @@ class GPTConfig:
             f"hidden_size ({self.hidden_size}) must be divisible by "
             f"num_heads ({self.num_heads})"
         )
+        # num_kv_heads stays None (= num_heads) rather than being
+        # materialized: dataclasses.replace(cfg, num_heads=...) must keep
+        # working on configs that never asked for GQA. Resolved via the
+        # kv_heads property.
+        if self.num_kv_heads is not None:
+            assert self.num_heads % self.num_kv_heads == 0, (
+                f"num_heads ({self.num_heads}) must be divisible by "
+                f"num_kv_heads ({self.num_kv_heads})"
+            )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "
@@ -143,6 +158,12 @@ class GPTConfig:
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """Resolved K/V head count (num_kv_heads, defaulting to num_heads)."""
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
 
     @property
     def compute_dtype(self):
@@ -194,16 +215,19 @@ class GPTConfig:
         """Exact parameter count of the actual model.
 
         embed (tied with lm_head): V*H
-        per layer: attention 4*H^2 (q/k/v/o, no bias)
+        per layer: attention 2*H^2 (q/o) + 2*H*(kv_heads*head_dim) (k/v —
+                   equals 4*H^2 total without GQA), no bias
                    + FFN: SwiGLU 3*H*I (dense) or E*3*H*I + H*E router (MoE)
                    + 2 RMSNorm weight vectors (2*H)
         final RMSNorm: H
         """
         h, i = self.hidden_size, self.intermediate_size
+        kv = self.kv_heads * self.head_dim
         embed = self.vocab_size * h
         if self.num_experts > 0:
             ffn = self.num_experts * 3 * h * i + h * self.num_experts
         else:
             ffn = 3 * h * i
-        per_layer = 4 * h * h + ffn + 2 * h
+        attn = 2 * h * h + 2 * h * kv  # q/o full, k/v grouped
+        per_layer = attn + ffn + 2 * h
         return embed + self.num_layers * per_layer + h

@@ -90,20 +90,21 @@ class CausalSelfAttention(nn.Module):
         b, s, _ = x.shape
         dense = functools.partial(
             nn.Dense,
-            features=cfg.hidden_size,
             use_bias=False,
             dtype=cfg.compute_dtype,
             param_dtype=cfg.params_dtype,
             kernel_init=nn.initializers.normal(cfg.initializer_range),
         )
-        q = dense(name="q_proj")(x)
-        k = dense(name="k_proj")(x)
-        v = dense(name="v_proj")(x)
+        kv_features = cfg.kv_heads * cfg.head_dim
+        q = dense(features=cfg.hidden_size, name="q_proj")(x)
+        k = dense(features=kv_features, name="k_proj")(x)
+        v = dense(features=kv_features, name="v_proj")(x)
 
-        # [b, s, h*d] -> [b, s, heads, head_dim] (BSHD; no BHSD transpose on TPU)
+        # [b, s, h*d] -> [b, s, heads, head_dim] (BSHD; no BHSD transpose on
+        # TPU). Under GQA the k/v head dim is num_kv_heads (< num_heads).
         q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(b, s, cfg.num_heads, cfg.head_dim)
-        v = v.reshape(b, s, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.kv_heads, cfg.head_dim)
 
         if decode:
             out = self._decode_attention(q, k, v)
@@ -142,7 +143,7 @@ class CausalSelfAttention(nn.Module):
                 )
 
         out = out.reshape(b, s, cfg.hidden_size)
-        out = dense(name="o_proj")(out)
+        out = dense(features=cfg.hidden_size, name="o_proj")(out)
         out = _residual_dropout(cfg, self, out, deterministic)
         return out
 
@@ -157,12 +158,13 @@ class CausalSelfAttention(nn.Module):
         """
         cfg = self.config
         b, s, h, d = q.shape
+        kvh = k.shape[2]  # num_kv_heads: the GQA cache is group-fold smaller
         max_len = cfg.max_seq_len
         ck = self.variable(
-            "cache", "k", jnp.zeros, (b, max_len, h, d), cfg.compute_dtype
+            "cache", "k", jnp.zeros, (b, max_len, kvh, d), cfg.compute_dtype
         )
         cv = self.variable(
-            "cache", "v", jnp.zeros, (b, max_len, h, d), cfg.compute_dtype
+            "cache", "v", jnp.zeros, (b, max_len, kvh, d), cfg.compute_dtype
         )
         ci = self.variable(
             "cache", "idx", lambda: jnp.zeros((), jnp.int32)
@@ -181,6 +183,12 @@ class CausalSelfAttention(nn.Module):
             cv.value = v_all
             ci.value = idx + s
 
+        if kvh != h:
+            # Expand K/V heads to the query heads' groups for the einsum
+            # (decode batches are small; the cache itself stays compact).
+            from tpu_trainer.ops.attention import repeat_kv
+
+            k_all, v_all = repeat_kv(k_all, v_all, h)
         scale = 1.0 / (d ** 0.5)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * scale
         q_pos = idx + jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 0)

@@ -36,6 +36,22 @@ def causal_mask(seq_len: int) -> jax.Array:
     return jnp.tril(jnp.ones((seq_len, seq_len), dtype=jnp.bool_))
 
 
+def repeat_kv(k: jax.Array, v: jax.Array, num_heads: int):
+    """Expand grouped K/V heads to ``num_heads`` by contiguous-group repeat.
+
+    THE query-to-KV-head mapping: query head ``i`` reads K/V head
+    ``i // (num_heads // kv_heads)`` — the same contiguous-group order the
+    flash kernel's BlockSpec index map uses (``ops/flash.py``). Every
+    jnp-level GQA expansion goes through here so the mapping is pinned in
+    one place.
+    """
+    kvh = k.shape[2]
+    if kvh == num_heads:
+        return k, v
+    group = num_heads // kvh
+    return jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2)
+
+
 def _flash_mesh(q: jax.Array):
     """The active mesh context's mesh, when sharding the kernel is useful.
 
@@ -87,7 +103,7 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
     from tpu_trainer.ops import flash
 
     b, _, h, _ = q.shape
-    b_spec, h_spec = attention_shard_spec(mesh, b, h)
+    b_spec, h_spec = attention_shard_spec(mesh, b, h, k.shape[2])
     if b_spec is None and h_spec is None:
         return flash.flash_attention(q, k, v, **kernel_kwargs)
     spec = P(b_spec, None, h_spec, None)
@@ -161,9 +177,11 @@ def reference_attention(
     """Manual causal attention (reference ``gpt.py:230-234``).
 
     float32 softmax for stability (the reference passes ``dtype=torch.float32``
-    to softmax), dropout applied to the attention weights.
+    to softmax), dropout applied to the attention weights. Accepts grouped
+    K/V (``num_kv_heads < num_heads``) by head repetition — the GQA oracle.
     """
-    _, s, _, d = q.shape
+    _, s, h, d = q.shape
+    k, v = repeat_kv(k, v, h)
     scale = 1.0 / math.sqrt(d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     mask = causal_mask(s)
@@ -225,4 +243,6 @@ def flash_attention(
             deterministic=deterministic,
             dropout_rng=dropout_rng,
         )
+    # jax.nn.dot_product_attention handles grouped K/V natively (K heads
+    # dividing N) — pass the compact tensors straight through.
     return jax.nn.dot_product_attention(q, k, v, is_causal=True)

@@ -231,16 +231,22 @@ def _rope_specs(s, d):
 
 def _flash_forward(q, k, v, seed_f, rope, *, causal, block_q, block_k,
                    interpret, dropout_rate):
-    # q, k, v: BHSD [b, h, s, d]; seed_f: (1,1) float32 bit-carrier (floats
-    # so custom_vjp has a well-defined cotangent; re-bitcast to uint32 here,
-    # outside the kernel — Mosaic can't bitcast scalars in-kernel).
-    # rope: None or (cos, sin) [s, d] f32.
+    # q: BHSD [b, h, s, d]; k, v: [b, kvh, s, d] (kvh <= h: grouped-query
+    # attention shares one K/V head per group of h//kvh query heads — the
+    # kernel's K/V BlockSpec maps grid head ih to K/V head ih // group, so
+    # GQA costs nothing but the index map). seed_f: (1,1) float32
+    # bit-carrier (floats so custom_vjp has a well-defined cotangent;
+    # re-bitcast to uint32 here, outside the kernel — Mosaic can't bitcast
+    # scalars in-kernel). rope: None or (cos, sin) [s, d] f32.
     seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     b, h, s, d = q.shape
+    group = h // k.shape[1]
     scale = 1.0 / math.sqrt(d)
     grid = (b, h, s // block_q)
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0))
-    kv_spec = pl.BlockSpec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0))
+    kv_spec = pl.BlockSpec(
+        (1, 1, s, d), lambda ib, ih, iq: (ib, ih // group, 0, 0)
+    )
     row_spec = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, iq: (ib, ih, 0, 0))
     fuse_rope = rope is not None
     rope_args = tuple(rope) if fuse_rope else ()
@@ -406,6 +412,8 @@ def _bwd_fused_kernel(
 def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
                     block_k, interpret, dropout_rate, dlse=None):
     b, h, s, d = q.shape
+    kvh = k.shape[1]
+    group = h // kvh
     scale = 1.0 / math.sqrt(d)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term.
     delta = jnp.einsum(
@@ -419,6 +427,9 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
 
     seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     blk = lambda n: pl.BlockSpec((1, 1, n, d), lambda ib, ih, i: (ib, ih, i, 0))
+    kv_blk = lambda n: pl.BlockSpec(
+        (1, 1, n, d), lambda ib, ih, i: (ib, ih // group, i, 0)
+    )
     full = pl.BlockSpec((1, 1, s, d), lambda ib, ih, i: (ib, ih, 0, 0))
     row = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, i: (ib, ih, 0, 0))
     fuse_rope = rope is not None
@@ -428,12 +439,16 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
     # (its block index is constant in that dimension, so it stays in VMEM).
     # Under fused rope, dq and dk are un-rotated *inside* the kernel (VMEM)
     # before they are written — no external pass over the gradients.
+    # Under GQA each query head writes per-head dk/dv partials ([b, h, ...],
+    # the same size MHA's dk/dv would be); the group-sum below reduces them
+    # to the shared K/V heads.
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, block_q=block_q, scale=scale,
                           causal=causal, dropout_rate=dropout_rate,
                           fuse_rope=fuse_rope),
         grid=(b, h, s // block_k),
-        in_specs=[_seed_spec(), full, blk(block_k), blk(block_k), full, row, row]
+        in_specs=[_seed_spec(), full, kv_blk(block_k), kv_blk(block_k), full,
+                  row, row]
         + (_rope_specs(s, d) if fuse_rope else []),
         out_specs=[full, blk(block_k), blk(block_k)],
         out_shape=[
@@ -443,6 +458,11 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
         ],
         interpret=interpret,
     )(seed_f, q, k, v, do, lse, delta, *rope_args)
+    if group > 1:
+        dk = dk.astype(jnp.float32).reshape(b, kvh, group, s, d).sum(
+            axis=2).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(b, kvh, group, s, d).sum(
+            axis=2).astype(v.dtype)
     return dq.astype(q.dtype), dk, dv
 
 
@@ -454,7 +474,8 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
 @functools.lru_cache(maxsize=None)
 def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
                 dropout_rate: float, num_heads: int, head_dim: int,
-                fuse_rope: bool, return_lse: bool = False):
+                fuse_rope: bool, return_lse: bool = False,
+                num_kv_heads: Optional[int] = None):
     """custom_vjp'd kernel entry over *folded* ``[b, s, h*d]`` operands.
 
     The fold matters for memory: with head_dim 64, BSHD/BHSD tensors pad
@@ -468,19 +489,21 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
     kw = dict(causal=causal, block_q=block_q, block_k=block_k,
               interpret=interpret, dropout_rate=dropout_rate)
     h, d = num_heads, head_dim
+    kvh = num_kv_heads if num_kv_heads is not None else h
 
-    def to_bhsd(x3):
+    def to_bhsd(x3, heads=h):
         b, s, _ = x3.shape
-        return x3.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        return x3.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
 
     def to_flat(x4):
-        b, _, s, _ = x4.shape
-        return x4.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        b, nh, s, _ = x4.shape
+        return x4.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
 
     def _fwd(q3, k3, v3, seed_f, cos, sin):
         rope = (cos, sin) if fuse_rope else None
         o, lse = _flash_forward(
-            to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), seed_f, rope, **kw
+            to_bhsd(q3), to_bhsd(k3, kvh), to_bhsd(v3, kvh), seed_f, rope,
+            **kw
         )
         return to_flat(o), lse
 
@@ -503,8 +526,9 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
             q3, k3, v3, o3, lse, seed_f, cos, sin = res
             rope = (cos, sin) if fuse_rope else None
             dq, dk, dv = _flash_backward(
-                to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), to_bhsd(o3), lse,
-                to_bhsd(do3), seed_f, rope, dlse=dlse, **kw
+                to_bhsd(q3), to_bhsd(k3, kvh), to_bhsd(v3, kvh),
+                to_bhsd(o3), lse, to_bhsd(do3), seed_f, rope, dlse=dlse,
+                **kw
             )
             return (to_flat(dq), to_flat(dk), to_flat(dv),
                     jnp.zeros_like(seed_f), jnp.zeros_like(cos),
@@ -525,8 +549,8 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         q3, k3, v3, o3, lse, seed_f, cos, sin = res
         rope = (cos, sin) if fuse_rope else None
         dq, dk, dv = _flash_backward(
-            to_bhsd(q3), to_bhsd(k3), to_bhsd(v3), to_bhsd(o3), lse,
-            to_bhsd(do3), seed_f, rope, **kw
+            to_bhsd(q3), to_bhsd(k3, kvh), to_bhsd(v3, kvh), to_bhsd(o3),
+            lse, to_bhsd(do3), seed_f, rope, **kw
         )
         return (to_flat(dq), to_flat(dk), to_flat(dv),
                 jnp.zeros_like(seed_f), jnp.zeros_like(cos),
@@ -565,6 +589,10 @@ def flash_attention(
     b, s, h, d = q.shape
     if dropout_rate > 0.0 and dropout_rng is None:
         raise ValueError("dropout_rate > 0 requires dropout_rng")
+    if h % k.shape[2] != 0:
+        raise ValueError(
+            f"num_heads {h} not divisible by num_kv_heads {k.shape[2]}"
+        )
     if return_lse and (s % 128 != 0 or s < 128):
         # The lse variant exists for blockwise composition (ring attention);
         # its callers check tiling first, so this is a programming error.
@@ -599,6 +627,7 @@ def flash_attention(
                 q, k, v, dropout_rate=dropout_rate, deterministic=False,
                 dropout_rng=dropout_rng,
             )
+        # jax.nn.dot_product_attention handles grouped K/V natively.
         return jax.nn.dot_product_attention(q, k, v, is_causal=causal)
     if dropout_rate > 0.0:
         if s >= 2**16:
@@ -614,15 +643,16 @@ def flash_attention(
         cos, sin = rope[0].astype(jnp.float32), rope[1].astype(jnp.float32)
     else:
         cos = sin = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
+    kvh = k.shape[2]
     fn = _make_flash(
         causal, block_q, block_k, interpret, float(dropout_rate), h, d,
-        fuse_rope, return_lse,
+        fuse_rope, return_lse, kvh,
     )
     # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals);
     # the kernel-internal layout is BHSD for the (seq, head_dim) tiling.
     out = fn(
-        q.reshape(b, s, h * d), k.reshape(b, s, h * d),
-        v.reshape(b, s, h * d), seed_f, cos, sin,
+        q.reshape(b, s, h * d), k.reshape(b, s, kvh * d),
+        v.reshape(b, s, kvh * d), seed_f, cos, sin,
     )
     if return_lse:
         o3, lse = out

@@ -96,6 +96,10 @@ def _chunk_attention_jnp(q, k, v, causal, scale, dropout_rate, rng):
     with f32 accumulation — the flash kernel's dtype discipline.
     """
     b, sl, h, d = q.shape
+    if k.shape[2] != h:  # GQA: the ring carries compact K/V; expand locally
+        from tpu_trainer.ops.attention import repeat_kv
+
+        k, v = repeat_kv(k, v, h)
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
@@ -220,7 +224,7 @@ def ring_attention(
         attention_shard_coord, attention_shard_spec,
     )
 
-    b_spec, h_spec = attention_shard_spec(mesh, b, h)
+    b_spec, h_spec = attention_shard_spec(mesh, b, h, k.shape[2])
     spec = P(b_spec, axis_name, h_spec, None)
     import functools
 

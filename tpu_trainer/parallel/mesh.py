@@ -163,7 +163,8 @@ def dp_size(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
 
 
-def attention_shard_spec(mesh: Mesh, batch: int, heads: int):
+def attention_shard_spec(mesh: Mesh, batch: int, heads: int,
+                         kv_heads: Optional[int] = None):
     """PartitionSpec components for ``[b, s, h, d]`` attention operands.
 
     Attention is independent across batch and heads, so those dims shard
@@ -173,12 +174,21 @@ def attention_shard_spec(mesh: Mesh, batch: int, heads: int):
     replicated. Shared by the flash-kernel shard_map wrapper
     (``ops/attention.py``) and ring attention (``ops/ring.py``).
 
+    Under GQA pass ``kv_heads``: heads shard over ``tensor`` only when the
+    K/V heads divide too — a manual region whose q-head shard doesn't own
+    its group's K/V head would read the wrong one.
+
     Returns ``(b_spec, h_spec)`` — each an axis (tuple) or None.
     """
     dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
     b_spec = (DATA_AXIS, FSDP_AXIS) if (dp > 1 and batch % dp == 0) else None
     tp = mesh.shape[TENSOR_AXIS]
-    h_spec = TENSOR_AXIS if (tp > 1 and heads % tp == 0) else None
+    kv_heads = heads if kv_heads is None else kv_heads
+    h_spec = (
+        TENSOR_AXIS
+        if (tp > 1 and heads % tp == 0 and kv_heads % tp == 0)
+        else None
+    )
     return b_spec, h_spec
 
 

@@ -137,6 +137,9 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                    help="GPipe microbatches per step (default: one per stage)")
     p.add_argument("--num_experts", type=int, default=None,
                    help="> 0 turns every block's FFN into a routed MoE")
+    p.add_argument("--num_kv_heads", type=int, default=None,
+                   help="grouped-query attention: K/V heads (< num_heads "
+                        "shrinks the KV cache by the group factor)")
     p.add_argument("--multihost", action="store_true", default=None,
                    help="force jax.distributed.initialize() autodetect")
     p.add_argument("--device", type=str, default=None,
@@ -217,6 +220,7 @@ def resolve_configs(args, mode: str):
         ("use_flash_attention", "use_flash_attention"),
         ("gradient_checkpointing", "gradient_checkpointing"),
         ("num_experts", "num_experts"),
+        ("num_kv_heads", "num_kv_heads"),
         ("expert_capacity_factor", "expert_capacity_factor"),
         ("moe_aux_weight", "moe_aux_weight"),
         ("remat_policy", "remat_policy"),
@@ -228,6 +232,8 @@ def resolve_configs(args, mode: str):
         overrides["max_seq_len"] = args.seq_len
     if args.num_experts is not None:
         overrides["num_experts"] = args.num_experts
+    if args.num_kv_heads is not None:
+        overrides["num_kv_heads"] = args.num_kv_heads
     if args.gradient_checkpointing:
         overrides["gradient_checkpointing"] = True
     if mode == "fsdp":

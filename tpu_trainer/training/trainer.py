@@ -149,6 +149,12 @@ class Trainer:
                     f"num_heads {self.model_config.num_heads} not divisible "
                     f"by tensor axis size {self.tp_size}"
                 )
+            if self.model_config.kv_heads % self.tp_size != 0:
+                raise ValueError(
+                    f"num_kv_heads {self.model_config.kv_heads} not "
+                    f"divisible by tensor axis size {self.tp_size} (each "
+                    f"tensor shard must own whole K/V-head groups)"
+                )
         self.stage_size = self.mesh.shape.get(mesh_lib.STAGE_AXIS, 1)
         if self.stage_size > 1:
             # Pipeline parallelism (parallel/pipeline.py): contiguous layer
