@@ -34,9 +34,13 @@ def pytest_report_header(config):
 # (pure-function math, data pipeline, harness logic, logging).
 _SLOW_MODULES = {
     "test_checkpoint", "test_cli", "test_decode", "test_distributed",
-    "test_flash", "test_infer", "test_model", "test_moe", "test_offload",
-    "test_pipeline", "test_ring", "test_tensor_parallel", "test_trainer",
+    "test_flash", "test_gqa", "test_infer", "test_model", "test_moe",
+    "test_offload", "test_pipeline", "test_ring", "test_tensor_parallel",
+    "test_trainer",
 }
+# The three biggest time sinks; `-m "slow and not heavy"` and `-m heavy`
+# split the slow lane into two <10-minute batches for capped CI processes.
+_HEAVY_MODULES = {"test_cli", "test_distributed", "test_pipeline"}
 
 
 def pytest_collection_modifyitems(config, items):
@@ -46,5 +50,7 @@ def pytest_collection_modifyitems(config, items):
         module = item.module.__name__.rsplit(".", 1)[-1]
         if module in _SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+            if module in _HEAVY_MODULES:
+                item.add_marker(pytest.mark.heavy)
         else:
             item.add_marker(pytest.mark.fast)
