@@ -151,6 +151,32 @@ class TestKernelDropout:
         analytic = jnp.sum(g * direction)
         np.testing.assert_allclose(fd, analytic, rtol=2e-2, atol=2e-2)
 
+    def test_mask_spatial_independence(self):
+        # Positions along a score row are consecutive integers, so the
+        # pre-mix hash values form a Weyl progression; the two mix rounds
+        # must break that lattice. Assert near-zero autocorrelation of the
+        # keep mask at small lags along rows and columns (lag-correlated
+        # masks would bias which attention weights co-survive).
+        from tpu_trainer.ops.flash import _keep_mask
+
+        rate = 0.5
+        bq = bk = 512
+        keep = np.asarray(
+            _keep_mask(jnp.uint32(0xDEADBEEF), jnp.uint32(3), 0, 0,
+                       bq, bk, 1024, rate)
+        ).astype(np.float64)
+        p = keep.mean()
+        assert abs(p - (1 - rate)) < 0.01
+        centered = keep - p
+        var = (centered ** 2).mean()
+        for lag in (1, 2, 7):
+            row_corr = (centered[:, :-lag] * centered[:, lag:]).mean() / var
+            col_corr = (centered[:-lag, :] * centered[lag:, :]).mean() / var
+            # ~N(0, 1/sqrt(n)) for independent bits, n = 512*511 ≈ 2.6e5
+            # -> sd ≈ 0.002; 0.01 is 5 sigma.
+            assert abs(row_corr) < 0.01, (lag, row_corr)
+            assert abs(col_corr) < 0.01, (lag, col_corr)
+
     def test_requires_rng(self):
         q, k, v = _rand_qkv(jax.random.PRNGKey(16), 1, 128, 1, 16)
         with pytest.raises(ValueError, match="dropout_rng"):

@@ -128,6 +128,14 @@ class GPTConfig:
     # the right choice for very deep models or fast iteration.
     scan_unroll: bool = True
 
+    # REPRODUCIBILITY NOTE: fused_loss, fast_dropout, and scan_unroll
+    # default on as of v0.2, and the dropout-hash gained a second mix round
+    # in v0.3. Each changes the dropout RNG stream and/or loss reduction
+    # numerics relative to v0.1 — the same seed no longer reproduces a
+    # v0.1 run bit-for-bit (checkpoint/param layout is unchanged). To
+    # compare training curves against old runs, pin fused_loss=False,
+    # fast_dropout=False, scan_unroll=False deliberately.
+
     # TPU dtype policy: compute dtype for activations/matmuls; params and the
     # softmax/loss accumulations stay float32.
     dtype: str = "bfloat16"

@@ -62,13 +62,16 @@ def _keep_mask(seed_u32, salt_u32, q_start, k_start, bq: int, bk: int,
     and interpreted on CPU (``pltpu.prng_*`` has no interpret lowering).
     Positions must fit uint32: seq < 2**16.
 
-    The hash is deliberately minimal — 4 VPU ops per element on the
+    The hash is deliberately small — 6 VPU ops per element on the
     [block_q, block_k] score block (the kernel's hot elementwise chain):
-    the multiply mixes entropy into the high bits, the xorshift breaks the
-    multiply's linearity in the index (without it, adjacent columns'
-    hashes differ by a constant and the keep mask is spatially
-    correlated), and the threshold compare reads mostly high bits. Full
-    murmur avalanche buys nothing for a Bernoulli mask.
+    two multiply+xorshift rounds. One round is not enough: consecutive
+    positions along a row make the pre-mix values a Weyl progression with
+    stride 0xC2B2AE35, and a single xorshift only partially breaks that
+    lattice (keep decisions stay equidistributed but spatially
+    correlated). The second round restores per-element independence to
+    statistical quality (verified by the autocorrelation test in
+    tests/test_flash.py); the full murmur3 finalizer beyond that buys
+    nothing for a Bernoulli threshold.
     """
     # Per-row base on a [bq, 1] column (cheap) broadcast against the column
     # iota: one add per element instead of full 2-D index arithmetic.
@@ -80,6 +83,8 @@ def _keep_mask(seed_u32, salt_u32, q_start, k_start, bq: int, bk: int,
     x = x ^ (seed_u32 + salt_u32 * jnp.uint32(_GOLDEN))
     x = x * jnp.uint32(0xC2B2AE35)
     x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
     threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
     return x >= threshold  # keep with probability 1 - rate
 
@@ -101,8 +106,12 @@ def _rotate(x, cos, sin, out_dtype, scale=1.0):
 
     ``scale`` folds the attention's ``1/sqrt(d)`` into the (cheap) per-block
     q rotation so the [block_q, block_k] score matrix needs no per-element
-    multiply; for power-of-two head dims (all the GPT-2 geometries) the
-    scale is exact in bf16.
+    multiply. For even powers of two (d=16, 64, 256) the scale is itself a
+    power of two, so the fold only adjusts exponents and is exact in bf16;
+    for d=32/128 the scale is irrational and the folded q rounds once in
+    the bf16 cast — one extra bf16-level rounding per q element relative
+    to scaling the f32 score matrix, inside the tolerance the kernel tests
+    already allow for bf16 inputs (tests/test_flash.py oracle comparison).
     """
     half = x.shape[-1] // 2
     x32 = x.astype(jnp.float32)
@@ -440,8 +449,12 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
     # Under fused rope, dq and dk are un-rotated *inside* the kernel (VMEM)
     # before they are written — no external pass over the gradients.
     # Under GQA each query head writes per-head dk/dv partials ([b, h, ...],
-    # the same size MHA's dk/dv would be); the group-sum below reduces them
-    # to the shared K/V heads.
+    # the same size MHA's dk/dv would be). The partials leave the kernel in
+    # f32 so the group-sum accumulates at full precision and rounds to the
+    # storage dtype exactly once, after the reduction — not once per
+    # partial (the [b, h, s, d] f32 footprint is the same one the MHA dq
+    # already pays).
+    kv_grad_dtype = jnp.float32 if group > 1 else k.dtype
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, block_q=block_q, scale=scale,
                           causal=causal, dropout_rate=dropout_rate,
@@ -453,16 +466,14 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
         out_specs=[full, blk(block_k), blk(block_k)],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), kv_grad_dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), kv_grad_dtype),
         ],
         interpret=interpret,
     )(seed_f, q, k, v, do, lse, delta, *rope_args)
     if group > 1:
-        dk = dk.astype(jnp.float32).reshape(b, kvh, group, s, d).sum(
-            axis=2).astype(k.dtype)
-        dv = dv.astype(jnp.float32).reshape(b, kvh, group, s, d).sum(
-            axis=2).astype(v.dtype)
+        dk = dk.reshape(b, kvh, group, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, kvh, group, s, d).sum(axis=2).astype(v.dtype)
     return dq.astype(q.dtype), dk, dv
 
 

@@ -340,20 +340,59 @@ class Trainer:
 
     # --- the step -----------------------------------------------------------
 
-    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, dict]:
-        """One optimizer step over ``accum`` micro-batches.
-
-        ``batch``: the sharded ``[accum, global_bs, seq]`` device array from
-        ``put_batch``, or a **process-local** host array of shape
-        ``[accum * local_bs, seq]`` (or ``[accum, local_bs, seq]``), which is
-        placed automatically.
-        """
+    def _place_batch(self, batch) -> jax.Array:
+        """Host array ``[accum * local_bs, seq]`` (or ``[accum, local_bs,
+        seq]``) → the sharded ``[accum, global_bs, seq]`` device array the
+        jitted step expects; device arrays pass through."""
         if not isinstance(batch, jax.Array):
             batch = np.asarray(batch)
             if batch.ndim == 3:
                 batch = batch.reshape(-1, batch.shape[-1])
             batch = self.put_batch(batch)
-        return self._step_jit(state, batch)
+        return batch
+
+    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, dict]:
+        """One optimizer step over ``accum`` micro-batches.
+
+        ``batch``: the sharded ``[accum, global_bs, seq]`` device array from
+        ``put_batch``, or a **process-local** host array, which is placed
+        automatically (``_place_batch``).
+        """
+        return self._step_jit(state, self._place_batch(batch))
+
+    def step_memory_analysis(self, state: TrainState, batch) -> Optional[dict]:
+        """Compiler-reported per-device HBM footprint of the compiled train
+        step, in bytes.
+
+        Fallback memory accounting for runtimes that hide
+        ``device.memory_stats()`` (e.g. the axon TPU tunnel returns None):
+        the XLA executable's own ``memory_analysis`` works regardless of
+        runtime introspection. ``peak_bytes`` ≈ arguments + outputs +
+        temporaries − aliased (the donated train state aliases its output, so
+        it is counted once). Returns None when the backend doesn't expose the
+        analysis.
+        """
+        batch = self._place_batch(batch)
+        # Same jit object + same shapes as the running step: this hits the
+        # existing executable cache rather than recompiling.
+        compiled = self._step_jit.lower(state, batch).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        try:
+            arg = ma.argument_size_in_bytes
+            out = ma.output_size_in_bytes
+            tmp = ma.temp_size_in_bytes
+            alias = ma.alias_size_in_bytes
+        except AttributeError:
+            return None
+        return {
+            "argument_bytes": arg,
+            "output_bytes": out,
+            "temp_bytes": tmp,
+            "alias_bytes": alias,
+            "peak_bytes": arg + out + tmp - alias,
+        }
 
     def eval_step(self, state: TrainState, batch) -> jax.Array:
         """Forward-only mean loss on one ``[rows, seq]`` batch (deterministic,
