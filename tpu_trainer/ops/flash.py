@@ -5,31 +5,43 @@ the reference's call into torch's fused ``scaled_dot_product_attention``
 (``/root/reference/src/models/gpt.py:199-206``) — except implemented here as a
 blockwise-streaming kernel rather than a library call.
 
-Design (standard flash-attention-2 structure, written for the TPU memory
-hierarchy):
+Design (flash-attention-2 structure, written for the TPU memory hierarchy;
+every structural choice below is trace-measured on v5e — see
+benchmarks/results.md "Round-3 kernel push"):
 
-- Grid ``(batch, heads, seq // block_q)``; each program owns one query block
-  in VMEM and streams key/value blocks through the MXU with an online
-  (running max / running sum) softmax. The ``[seq, seq]`` score matrix is
-  never materialized in HBM — this is what removes the O(S^2) activation
-  memory of the XLA fallback path.
-- Causality skips whole key blocks above the diagonal (the inner
-  ``fori_loop`` upper bound is the diagonal block), halving the FLOPs.
-- Backward is one fused kernel (grid over key blocks): a single
+- Grid ``(batch, heads/hp, seq // block_q)`` with ``hp`` heads per program
+  (2 for head_dim 64 so the block lane width is 128; 1 for d%128==0).
+  Each program owns one query block in VMEM and walks key/value blocks
+  through the MXU with an online (running max / running sum) softmax. The
+  ``[seq, seq]`` score matrix is never materialized in HBM — this is what
+  removes the O(S^2) activation memory of the XLA fallback path.
+- Block loops are STATIC Python unrolls with ``pl.when``-predicated bodies
+  (softmax state in VMEM scratch), not ``fori_loop``s with data-dependent
+  trip counts — Mosaic cannot schedule those, and causality's skipped
+  blocks measured as costing full price. At ``seq <= block`` a
+  single-block fast path drops the online softmax entirely.
+- Operands are the model's FOLDED ``[b, s, h*d]`` layout, sliced per
+  head(-pair) by the BlockSpecs: no BSHD transpose ever exists in HBM.
+- Backward is one fused kernel (grid over key blocks) with its own block
+  shape (512x512: it is FLOP-bound, causal skipping wins): one
   score/probability evaluation per block pair feeds dk, dv, and dq — dq
   accumulates in f32 in a VMEM-resident full-row block across sequential
   grid steps — using the saved per-row logsumexp and the precomputed
   ``delta = rowsum(dO * O)``.
-- Attention-weight dropout runs in-kernel from a counter-based hash mask
-  (regenerated bit-identically in the backward); RoPE optionally fuses in
-  (q/k rotate in VMEM against [seq, head_dim] tables).
+- Attention-weight dropout runs in-kernel from the core's hardware PRNG
+  (compiled) or a counter-based hash (interpret), generated in fixed
+  512x512 tiles keyed by absolute position so the backward regenerates
+  bit-identical masks under its different block shape.
+- RoPE fuses in: q/k rotate in VMEM, and the forward *emits* the rotated
+  (+ 1/sqrt(d)-scaled) q/k as outputs that replace the raw projections in
+  the autodiff residuals — the backward never re-rotates per block.
 - All accumulation in float32 regardless of input dtype (bf16 in, bf16 out).
 
 The public API is BSHD ``[batch, seq, heads, head_dim]`` (the model's
-layout); internally the kernel uses BHSD so the (seq, head_dim) pair lands in
-the last two dims, as the TPU (sublane, lane) tiling requires. Sequence
-lengths must be multiples of the block size; the wrapper falls back to XLA
-fused attention otherwise.
+layout), folded to ``[b, s, h*d]`` at the custom_vjp boundary so saved
+residuals stay unpadded. Sequence lengths must be multiples of the block
+size and head_dim must be 64 or a multiple of 128 when compiled; the
+wrapper falls back to XLA fused attention otherwise.
 """
 
 from __future__ import annotations
@@ -42,11 +54,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-# 512-blocks keep the MXU busy (a [512,64]x[64,512] dot per inner step);
-# 128-blocks measure ~2.3x slower end to end on v5e (pipeline bubbles
+# 1024-blocks won the v5e sweep: at s=1024 the whole head fits one block
+# (no online-softmax rescaling at all — the kernel's single-block fast
+# path, ~33% faster than 512-block streaming), and for longer sequences
+# the [1024, 1024] score block still amortizes the per-block VPU work
+# best. 128-blocks measure ~2.3x slower end to end (pipeline bubbles
 # dominate the small dots). The wrapper clamps to the sequence length.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+# The backward is FLOP-bound (5 dots/block, no online rescan): causal
+# block-skipping at 512 measured faster than the single-block layout.
+_BWD_BLOCK = 512
 _NEG_INF = float("-inf")
 _GOLDEN = 0x9E3779B9  # Weyl increment for the per-(batch,head) salt
 
@@ -87,6 +105,58 @@ def _keep_mask(seed_u32, salt_u32, q_start, k_start, bq: int, bk: int,
     x = x ^ (x >> 13)
     threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
     return x >= threshold  # keep with probability 1 - rate
+
+
+def _keep(seed, salt, q_start, k_start, bq: int, bk: int, seq: int,
+          rate: float, hw: bool):
+    """Keep-mask for one [bq, bk] score block of one head. Two backends:
+
+    - ``hw=True`` (compiled TPU): the core's hardware PRNG, reseeded
+      deterministically per (seed, batch*head salt, block coordinates) so
+      the backward kernels regenerate the identical mask from the same
+      seed args (fwd and bwd block shapes are forced equal under
+      dropout). Replaces ~8 VPU ops/element of hash arithmetic with a
+      hardware bit stream + one compare. Generation is per head — a
+      single [hp*bq, bk] generation for a paired program keeps an 8 MB
+      uint32 block live across both heads' chains and blows the 16 MB
+      scoped-VMEM budget in the in-model backward.
+    - ``hw=False`` (interpret mode / CPU tests): the multiply-xorshift
+      hash (``_keep_mask``) — ``pltpu.prng_*`` has no interpret lowering.
+
+    The two backends draw different (both valid Bernoulli) masks; each is
+    deterministic per seed within its backend, which is what training and
+    the fwd/bwd mask-consistency contract require.
+    """
+    threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
+    if hw:
+        from jax.experimental.pallas import tpu as pltpu
+
+        # Generation runs in fixed 512x512 TILES keyed by absolute
+        # coordinates, so the mask a block sees is independent of the
+        # block shape as long as both passes use 512-divisible (or equal)
+        # blocks — this is what lets the forward run its single-block
+        # layout while the backward runs causal-skipping 512s. Mosaic's
+        # prng_seed takes at most 2 scalars: fold the user seed with the
+        # (batch, head) salt, and the tile coordinates into one position
+        # unique per tile (mod 2^32 — still collision-free since
+        # q*seq + k < seq^2 <= 2^32 for seq < 2**16).
+        s0 = seed ^ (salt * jnp.uint32(_GOLDEN))
+        tq = 512 if bq % 512 == 0 else bq
+        tk = 512 if bk % 512 == 0 else bk
+        rows = []
+        for a in range(0, bq, tq):
+            row = []
+            for c in range(0, bk, tk):
+                pos = (jnp.uint32(q_start + a) * jnp.uint32(seq)
+                       + jnp.uint32(k_start + c))
+                pltpu.prng_seed(s0, pos)
+                row.append(pltpu.bitcast(pltpu.prng_random_bits((tq, tk)),
+                                         jnp.uint32))
+            rows.append(row[0] if len(row) == 1
+                        else jnp.concatenate(row, axis=1))
+        bits = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
+        return bits >= threshold
+    return _keep_mask(seed, salt, q_start, k_start, bq, bk, seq, rate)
 
 
 def _block_salt():
@@ -137,95 +207,177 @@ def _unrotate_grad(g, cos, sin):
 
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
-                block_k, scale, causal, dropout_rate, fuse_rope):
-    # q_ref: [1, 1, block_q, d]; k_ref/v_ref: [1, 1, seq, d];
-    # lse_ref: [1, 1, 1, seq] (full row, written blockwise).
-    # With fuse_rope, cos/sin [seq, d] ride along and q/k blocks rotate in
-    # VMEM — no rotated copies ever hit HBM.
+                block_k, scale, causal, dropout_rate, fuse_rope, hw_prng,
+                hp):
+    # Operands are the model's FOLDED layout, sliced per head *group* by
+    # the BlockSpec: q_ref [1, block_q, hp*d] and k_ref/v_ref
+    # [1, seq, hp*d] are column slices of [b, s, h*d] arrays. ``hp`` is
+    # the number of heads per program — 2 for d=64 so the block's lane
+    # width is 128 (Mosaic requires the last block dim to be a multiple
+    # of 128 or the full array width), 1 for d a multiple of 128. Heads
+    # within a program run as a static Python loop over static column
+    # slices. No BSHD transpose ever happens in HBM — round 2 transposed
+    # to [b, h, s, d] around every pallas call, costing a layout copy per
+    # operand per layer. lse_ref: [1, hp, 1, seq] (full rows, written
+    # blockwise). With fuse_rope, cos/sin [seq, d] ride along and q/k
+    # rotate in VMEM — no rotated copies hit HBM.
+    #
+    # The k loop is a STATIC Python unroll with `pl.when`-predicated block
+    # bodies (the splash-attention structure), not a `fori_loop` with
+    # data-dependent trip counts. Measured on v5e: with dynamic trip
+    # counts Mosaic cannot unroll/schedule the loop and the causal kernel
+    # ran no faster than computing every block — causality's 2x FLOP
+    # saving bought zero time. Static unroll + predication makes skipped
+    # blocks actually free (a branch), and lets the scheduler software-
+    # pipeline across block bodies. Softmax state (m, l, acc) lives in
+    # per-head VMEM scratch across the predicated regions.
+    # Under fuse_rope the kernel additionally WRITES the rotated
+    # (and, for q, pre-scaled) projections as outputs: the backward then
+    # consumes them directly instead of re-rotating q/k per block — the
+    # rotate_half concatenate is a cross-lane shuffle, measured ~0.3 ms
+    # per layer in the in-model backward. Same residual footprint (the
+    # rotated tensors replace the raw ones in the autodiff save).
     if fuse_rope:
-        cos_ref, sin_ref, o_ref, lse_ref = rest
+        cos_ref, sin_ref, o_ref, lse_ref, qr_ref, kr_ref, *scrs = rest
     else:
-        o_ref, lse_ref = rest
-    block_q = q_ref.shape[2]
-    d = q_ref.shape[3]
-    seq = k_ref.shape[2]
+        o_ref, lse_ref, *scrs = rest
+        qr_ref = kr_ref = None
+    m_scrs, l_scrs, acc_scrs = scrs[:hp], scrs[hp:2 * hp], scrs[2 * hp:]
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2] // hp
+    seq = k_ref.shape[1]
     iq = pl.program_id(2)
     q_start = iq * block_q
     seed = _seed_from_ref(seed_ref)
-    salt = _block_salt()
+
+    def head_salt(t):
+        # Unique per (batch, global head); equals _block_salt at hp == 1,
+        # keeping the interpret-mode hash stream bit-stable with round 2.
+        return _block_salt() * jnp.uint32(hp) + jnp.uint32(t)
 
     # Inputs stay in their storage dtype (bf16 in training): the MXU runs
     # bf16 x bf16 -> f32 at full rate, while f32 x f32 matmuls cost ~8x.
     # All softmax state is f32 via preferred_element_type. The 1/sqrt(d)
     # scale is folded into q once per program ([bq, d]) rather than into
     # every [bq, bk] score block.
-    q = q_ref[0, 0, :, :]  # [bq, d]
-    if fuse_rope:
-        q = _rotate(q, cos_ref[pl.ds(q_start, block_q), :],
-                    sin_ref[pl.ds(q_start, block_q), :], q_ref.dtype,
-                    scale=scale)
-    else:
-        q = (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
-
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    def body(ik, carry, masked):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(ik * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(ik * block_k, block_k), :]
+    def load_q(t):
+        q = q_ref[0, :, pl.ds(t * d, d)]  # [bq, d], static column slice
         if fuse_rope:
-            k = _rotate(k, cos_ref[pl.ds(ik * block_k, block_k), :],
-                        sin_ref[pl.ds(ik * block_k, block_k), :], k_ref.dtype)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [bq, bk] f32 (already scaled via q)
-        if masked:
-            row = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        # The softmax normalizer sums the *undropped* weights (dropout acts
-        # on normalized weights in the reference, gpt.py:230-234 semantics).
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if dropout_rate > 0.0:
-            # Survivors keep their raw weight here; the 1/(1-rate) inverted-
-            # dropout scale folds into the final acc/l division (one [bq, 1]
-            # multiply) instead of a per-element multiply per block.
-            keep = _keep_mask(seed, salt, q_start, ik * block_k,
-                              block_q, block_k, seq, dropout_rate)
-            p = jnp.where(keep, p, 0.0)
-        acc_new = acc * alpha + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
+            q = _rotate(q, cos_ref[pl.ds(q_start, block_q), :],
+                        sin_ref[pl.ds(q_start, block_q), :], q_ref.dtype,
+                        scale=scale)
+            qr_ref[0, :, pl.ds(t * d, d)] = q
+            return q
+        return (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
 
-    carry = (m0, l0, acc0)
+    single = seq == block_k and seq == block_q
+    if single:
+        # Whole-sequence single block (the s <= 1024 fast path, and the
+        # headline-config shape): no online softmax, no rescaling, no
+        # scratch round-trips — one straight-line masked softmax per
+        # (batch, head). Measured ~33% faster than 512-block streaming on
+        # v5e at s=1024 even though the masked upper triangle is computed.
+        if causal:
+            diff = (jax.lax.broadcasted_iota(jnp.int32, (block_q, seq), 0)
+                    - jax.lax.broadcasted_iota(jnp.int32, (block_q, seq), 1))
+        for t in range(hp):
+            q = load_q(t)
+            k = k_ref[0, :, pl.ds(t * d, d)]
+            v = v_ref[0, :, pl.ds(t * d, d)]
+            if fuse_rope:
+                k = _rotate(k, cos_ref[...], sin_ref[...], k_ref.dtype)
+                kr_ref[0, :, pl.ds(t * d, d)] = k
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if causal:
+                s = jnp.where(diff >= 0, s, _NEG_INF)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            if dropout_rate > 0.0:
+                keep = _keep(seed, head_salt(t), 0, 0, block_q, block_k,
+                             seq, dropout_rate, hw_prng)
+                p = jnp.where(keep, p, 0.0)
+            acc = jnp.dot(p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32)
+            denom = l * (1.0 - dropout_rate) if dropout_rate > 0.0 else l
+            o_ref[0, :, pl.ds(t * d, d)] = (acc / denom).astype(o_ref.dtype)
+            lse_ref[0, t, 0, :] = m[:, 0] + jnp.log(l[:, 0])
+        return
+
+    for t in range(hp):
+        m_scrs[t][...] = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l_scrs[t][...] = jnp.zeros((block_q, 1), jnp.float32)
+        acc_scrs[t][...] = jnp.zeros((block_q, d), jnp.float32)
+
     if causal:
-        # Key blocks strictly below the diagonal need no mask; only blocks
-        # straddling it do. Splitting the loop keeps the iota/compare/select
-        # chain off the interior blocks.
-        num_full = q_start // block_k
-        num_k = (q_start + block_q + block_k - 1) // block_k
-        carry = jax.lax.fori_loop(
-            0, num_full, functools.partial(body, masked=False), carry
-        )
-        carry = jax.lax.fori_loop(
-            num_full, num_k, functools.partial(body, masked=True), carry
-        )
-    else:
-        num_k = seq // block_k
-        carry = jax.lax.fori_loop(
-            0, num_k, functools.partial(body, masked=False), carry
-        )
-    m, l, acc = carry
+        # Row-minus-column iota difference, hoisted out of the block loop:
+        # the diagonal block's mask is `diff >= k_start - q_start`, one
+        # compare + one select per element instead of two iotas + compare +
+        # select inside every masked block.
+        diff = (jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                - jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1))
 
-    denom = l * (1.0 - dropout_rate) if dropout_rate > 0.0 else l
-    o_ref[0, 0, :, :] = (acc / denom).astype(o_ref.dtype)
-    lse_ref[0, 0, 0, pl.ds(q_start, block_q)] = m[:, 0] + jnp.log(l[:, 0])
+    qs = [load_q(t) for t in range(hp)]
+
+    def body(ik: int, masked: bool):
+        k_start = ik * block_k  # static
+        for t in range(hp):
+            m, l, acc = m_scrs[t][...], l_scrs[t][...], acc_scrs[t][...]
+            k = k_ref[0, pl.ds(k_start, block_k), pl.ds(t * d, d)]
+            v = v_ref[0, pl.ds(k_start, block_k), pl.ds(t * d, d)]
+            if fuse_rope:
+                k = _rotate(k, cos_ref[pl.ds(k_start, block_k), :],
+                            sin_ref[pl.ds(k_start, block_k), :], k_ref.dtype)
+                kr_ref[0, pl.ds(k_start, block_k), pl.ds(t * d, d)] = k
+            s = jax.lax.dot_general(
+                qs[t], k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk] f32 (already scaled via q)
+            if masked:
+                s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            # The softmax normalizer sums the *undropped* weights (dropout
+            # acts on normalized weights in the reference, gpt.py:230-234
+            # semantics).
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            if dropout_rate > 0.0:
+                # Survivors keep their raw weight here; the 1/(1-rate)
+                # inverted-dropout scale folds into the final acc/l division
+                # (one [bq, 1] multiply) instead of a per-element multiply
+                # per block.
+                keep = _keep(seed, head_salt(t), q_start, k_start,
+                             block_q, block_k, seq, dropout_rate, hw_prng)
+                p = jnp.where(keep, p, 0.0)
+            m_scrs[t][...] = m_new
+            l_scrs[t][...] = l_new
+            acc_scrs[t][...] = acc * alpha + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            )
+
+    for ik in range(seq // block_k):
+        if not causal:
+            body(ik, masked=False)
+            continue
+        k_start = ik * block_k
+        # needed: any (row, col) with row >= col, i.e. the block's last row
+        # reaches its first column. full: every element valid (last column
+        # <= first row). Both predicates depend on the dynamic q_start.
+        needed = q_start + block_q - 1 >= k_start
+        full = q_start >= k_start + block_k - 1
+        pl.when(full)(functools.partial(body, ik, False))
+        pl.when(needed & jnp.logical_not(full))(
+            functools.partial(body, ik, True))
+
+    for t in range(hp):
+        m, l, acc = m_scrs[t][...], l_scrs[t][...], acc_scrs[t][...]
+        denom = l * (1.0 - dropout_rate) if dropout_rate > 0.0 else l
+        o_ref[0, :, pl.ds(t * d, d)] = (acc / denom).astype(o_ref.dtype)
+        lse_ref[0, t, 0, pl.ds(q_start, block_q)] = m[:, 0] + jnp.log(l[:, 0])
 
 
 def _seed_spec():
@@ -238,43 +390,85 @@ def _rope_specs(s, d):
     return [pl.BlockSpec((s, d), lambda ib, ih, i: (0, 0))] * 2
 
 
-def _flash_forward(q, k, v, seed_f, rope, *, causal, block_q, block_k,
-                   interpret, dropout_rate):
-    # q: BHSD [b, h, s, d]; k, v: [b, kvh, s, d] (kvh <= h: grouped-query
-    # attention shares one K/V head per group of h//kvh query heads — the
-    # kernel's K/V BlockSpec maps grid head ih to K/V head ih // group, so
-    # GQA costs nothing but the index map). seed_f: (1,1) float32
-    # bit-carrier (floats so custom_vjp has a well-defined cotangent;
-    # re-bitcast to uint32 here, outside the kernel — Mosaic can't bitcast
-    # scalars in-kernel). rope: None or (cos, sin) [s, d] f32.
-    seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
-    b, h, s, d = q.shape
-    group = h // k.shape[1]
-    scale = 1.0 / math.sqrt(d)
-    grid = (b, h, s // block_q)
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0))
-    kv_spec = pl.BlockSpec(
-        (1, 1, s, d), lambda ib, ih, iq: (ib, ih // group, 0, 0)
+def _heads_per_program(d: int, interpret: bool) -> int:
+    """Heads per kernel program. Mosaic needs the block's lane width to be
+    a multiple of 128 (or the full array width): d=64 pairs two heads per
+    program (width 128); d a multiple of 128 runs one head per program.
+    Interpret mode has no lane constraint — keep hp=1 so the CPU-test hash
+    salts stay bit-identical to the per-head design."""
+    if interpret:
+        return 1
+    if d == 64:
+        return 2
+    if d % 128 == 0:
+        return 1
+    raise NotImplementedError(
+        f"compiled flash kernel supports head_dim 64 or multiples of 128; "
+        f"got {d} (use the XLA fallback path)"
     )
-    row_spec = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, iq: (ib, ih, 0, 0))
+
+
+def _flash_forward(q3, k3, v3, seed_f, rope, *, num_heads, head_dim,
+                   num_kv_heads, causal, block_q, block_k, interpret,
+                   dropout_rate):
+    # q3: FOLDED [b, s, h*d]. k3/v3: [b, s, kvh*d] with kvh == h when
+    # hp > 1 (the caller expands grouped K/V to per-query-head copies —
+    # the repeated-KV-MHA identity — because a paired program's two query
+    # heads may straddle a K/V head boundary); under hp == 1 GQA stays an
+    # index map (grid head ih -> K/V columns (ih // group) * d). The
+    # BlockSpecs slice per-head-group [*, hp*d] columns straight out of
+    # the folded layout — no BSHD transpose/copy in HBM. seed_f: (1,1)
+    # float32 bit-carrier (floats so custom_vjp has a well-defined
+    # cotangent; re-bitcast to uint32 here, outside the kernel — Mosaic
+    # can't bitcast scalars in-kernel). rope: None or (cos, sin) [s, d]
+    # f32.
+    seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
+    b, s, _ = q3.shape
+    h, d = num_heads, head_dim
+    hp = _heads_per_program(d, interpret)
+    group = h // num_kv_heads
+    assert group == 1 or hp == 1, "caller expands K/V before pairing heads"
+    scale = 1.0 / math.sqrt(d)
+    grid = (b, h // hp, s // block_q)
+    q_spec = pl.BlockSpec((1, block_q, hp * d),
+                          lambda ib, ip, iq: (ib, iq, ip))
+    kv_spec = pl.BlockSpec(
+        (1, s, hp * d),
+        (lambda ib, ip, iq: (ib, 0, ip)) if hp > 1 or group == 1
+        else (lambda ib, ip, iq: (ib, 0, ip // group)),
+    )
+    row_spec = pl.BlockSpec((1, hp, 1, s), lambda ib, ip, iq: (ib, ip, 0, 0))
     fuse_rope = rope is not None
     rope_args = tuple(rope) if fuse_rope else ()
-    o, lse = pl.pallas_call(
+    from jax.experimental.pallas import tpu as pltpu
+
+    outs = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, scale=scale, causal=causal,
             dropout_rate=dropout_rate, fuse_rope=fuse_rope,
+            hw_prng=not interpret, hp=hp,
         ),
         grid=grid,
         in_specs=[_seed_spec(), q_spec, kv_spec, kv_spec]
         + (_rope_specs(s, d) if fuse_rope else []),
-        out_specs=[q_spec, row_spec],
+        out_specs=[q_spec, row_spec]
+        + ([q_spec, kv_spec] if fuse_rope else []),
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, s, h * d), q3.dtype),
             jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
-        ],
+        ]
+        + ([jax.ShapeDtypeStruct((b, s, h * d), q3.dtype),
+            jax.ShapeDtypeStruct(k3.shape, k3.dtype)] if fuse_rope else []),
+        scratch_shapes=(
+            [pltpu.VMEM((block_q, 1), jnp.float32)] * (2 * hp)
+            + [pltpu.VMEM((block_q, d), jnp.float32)] * hp
+        ),
         interpret=interpret,
-    )(seed_f, q, k, v, *rope_args)
-    return o, lse
+    )(seed_f, q3, k3, v3, *rope_args)
+    if fuse_rope:
+        return outs  # (o3, lse, rotated-scaled q3, rotated k3)
+    o3, lse = outs
+    return o3, lse, None, None
 
 
 # --------------------------------------------------------------------------
@@ -284,7 +478,7 @@ def _flash_forward(q, k, v, seed_f, rope, *, causal, block_q, block_k,
 
 def _bwd_fused_kernel(
     seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, scale, causal, dropout_rate, fuse_rope,
+    block_q, scale, causal, dropout_rate, fuse_rope, hw_prng, hp,
 ):
     """Single-pass backward: grid ``(b, h, seq // block_k)``.
 
@@ -301,51 +495,57 @@ def _bwd_fused_kernel(
     applies the rotation's transpose (``_unrotate_grad``).
     """
     if fuse_rope:
-        cos_ref, sin_ref, dq_ref, dk_ref, dv_ref = rest
+        cos_ref, sin_ref, dq_ref, dk_ref, dv_ref, *scrs = rest
     else:
-        dq_ref, dk_ref, dv_ref = rest
-    block_k = k_ref.shape[2]
-    d = k_ref.shape[3]
-    seq = q_ref.shape[2]
+        dq_ref, dk_ref, dv_ref, *scrs = rest
+    dk_scrs, dv_scrs = scrs[:hp], scrs[hp:]
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2] // hp
+    seq = q_ref.shape[1]
     ik = pl.program_id(2)
     k_start = ik * block_k
     seed = _seed_from_ref(seed_ref)
-    salt = _block_salt()
+    num_q = seq // block_q
+    # Whole-sequence single block (mirrors the forward's fast path): no
+    # dq accumulation across programs, no scratch round-trips, and the
+    # dropout seed position is the same static (0, 0) the forward used.
+    single = num_q == 1 and seq == block_k
 
-    @pl.when(ik == 0)
-    def _zero_dq():
-        dq_ref[...] = jnp.zeros_like(dq_ref)
+    def head_salt(t):
+        return _block_salt() * jnp.uint32(hp) + jnp.uint32(t)
 
-    k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
-    if fuse_rope:
-        k = _rotate(k, cos_ref[pl.ds(k_start, block_k), :],
-                    sin_ref[pl.ds(k_start, block_k), :], k_ref.dtype)
+    # Under fuse_rope the forward already wrote rotated k and
+    # rotated-scaled q as outputs (see _fwd_kernel): they arrive here as
+    # the residuals, so no per-block re-rotation happens — only the final
+    # unrotate of dq/dk below needs cos/sin.
+    ks = [k_ref[0, :, pl.ds(t * d, d)] for t in range(hp)]
 
-    def body(iq, carry, masked):
-        dk, dv = carry
-        # q is loaded pre-scaled by 1/sqrt(d) (folded into the [bq, d] load /
-        # rotation): the score recompute then needs no per-element scale, and
-        # dk = sum ds^T @ q_scaled IS the correctly-scaled dk (chain rule
-        # puts one factor of `scale` on each of dq and dk).
-        q = q_ref[0, 0, pl.ds(iq * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(iq * block_q, block_q), :]
-        if fuse_rope:
-            q = _rotate(q, cos_ref[pl.ds(iq * block_q, block_q), :],
-                        sin_ref[pl.ds(iq * block_q, block_q), :], q_ref.dtype,
-                        scale=scale)
-        else:
+    def body(iq, t, masked: bool, out=None):
+        # ``iq``/``t`` are static Python ints: the q-block and head loops
+        # are unrolled at trace time with `pl.when` predication per block
+        # (see _fwd_kernel for the measured rationale). q is loaded
+        # pre-scaled by 1/sqrt(d) (folded into the [bq, d] load /
+        # rotation): the score recompute then needs no per-element scale,
+        # and dk = sum ds^T @ q_scaled IS the correctly-scaled dk (chain
+        # rule puts one factor of `scale` on each of dq and dk).
+        k, v = ks[t], v_ref[0, :, pl.ds(t * d, d)]
+        q_start = iq * block_q
+        q = q_ref[0, pl.ds(q_start, block_q), pl.ds(t * d, d)]
+        do = do_ref[0, pl.ds(q_start, block_q), pl.ds(t * d, d)]
+        if not fuse_rope:
+            # fuse_rope residuals arrive pre-scaled (the forward folds
+            # 1/sqrt(d) into the q rotation it writes back).
             q = (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
-        lse = lse_ref[0, 0, 0, pl.ds(iq * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, 0, pl.ds(iq * block_q, block_q)][:, None]
+        lse = lse_ref[0, t, 0, pl.ds(q_start, block_q)][:, None]
+        delta = delta_ref[0, t, 0, pl.ds(q_start, block_q)][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, bk] (scaled via q)
         if masked:
-            row = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            col = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
+            diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                    - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
         p = jnp.exp(s - lse)                       # [bq, bk] (normalized)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -353,58 +553,87 @@ def _bwd_fused_kernel(
         if dropout_rate > 0.0:
             # p_drop stays unscaled; the 1/(1-rate) folds into dv once at
             # the end ([bk, d] multiply instead of per-element per block).
-            keep = _keep_mask(seed, salt, iq * block_q, k_start,
-                              block_q, block_k, seq, dropout_rate)
+            keep = _keep(seed, head_salt(t), iq * block_q, k_start,
+                         block_q, block_k, seq, dropout_rate, hw_prng)
             p_drop = jnp.where(keep, p, 0.0)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         else:
             p_drop = p
-        dv_new = dv + jax.lax.dot_general(
+        dv_new = jax.lax.dot_general(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)                      # [bq, bk]
-        dk_new = dk + jax.lax.dot_general(
+        dk_new = jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dq_part = jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         ) * scale
-        sl = pl.ds(iq * block_q, block_q)
-        dq_ref[0, 0, sl, :] += dq_part.astype(dq_ref.dtype)
-        return dk_new, dv_new
+        if out is not None:
+            # Single-block: grads are complete after this one body.
+            out.append((dk_new, dv_new, dq_part))
+        else:
+            # Ref-based accumulation (pl.when bodies must return None).
+            sl = pl.ds(q_start, block_q)
+            dq_ref[0, sl, pl.ds(t * d, d)] += dq_part.astype(dq_ref.dtype)
+            dk_scrs[t][...] += dk_new
+            dv_scrs[t][...] += dv_new
 
-    num_q = seq // block_q
-    zeros = (jnp.zeros((block_k, d), jnp.float32),
-             jnp.zeros((block_k, d), jnp.float32))
-    if causal:
-        # q blocks straddling the diagonal need the mask; q blocks strictly
-        # below it (q_start >= k_end - 1) do not.
-        start = k_start // block_q
-        clear_from = (k_start + block_k - 1 + block_q - 1) // block_q
-        carry = jax.lax.fori_loop(
-            start, jnp.minimum(clear_from, num_q),
-            functools.partial(body, masked=True), zeros,
-        )
-        dk, dv = jax.lax.fori_loop(
-            jnp.minimum(clear_from, num_q), num_q,
-            functools.partial(body, masked=False), carry,
-        )
-    else:
-        dk, dv = jax.lax.fori_loop(
-            0, num_q, functools.partial(body, masked=False), zeros
-        )
-    if fuse_rope:
-        # dk leaves the kernel already un-rotated (the rotation's transpose
-        # applied in VMEM) — no external f32 read-modify-write pass.
-        cos_k = cos_ref[pl.ds(k_start, block_k), :]
-        sin_k = sin_ref[pl.ds(k_start, block_k), :]
-        dk = _unrotate_grad(dk, cos_k, sin_k)
-    if dropout_rate > 0.0:
-        dv = dv / (1.0 - dropout_rate)
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    if single:
+        for t in range(hp):
+            out = []
+            body(0, t, masked=causal, out=out)
+            dk, dv, dq = out[0]
+            if fuse_rope:
+                dq = _unrotate_grad(dq, cos_ref[...], sin_ref[...])
+                dk = _unrotate_grad(dk, cos_ref[...], sin_ref[...])
+            if dropout_rate > 0.0:
+                dv = dv / (1.0 - dropout_rate)
+            dq_ref[0, :, pl.ds(t * d, d)] = dq.astype(dq_ref.dtype)
+            dk_ref[0, :, pl.ds(t * d, d)] = dk.astype(dk_ref.dtype)
+            dv_ref[0, :, pl.ds(t * d, d)] = dv.astype(dv_ref.dtype)
+        return
+
+    @pl.when(ik == 0)
+    def _zero_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    for t in range(hp):
+        dk_scrs[t][...] = jnp.zeros((block_k, d), jnp.float32)
+        dv_scrs[t][...] = jnp.zeros((block_k, d), jnp.float32)
+    for iq in range(num_q):
+        q_start = iq * block_q
+
+        def run(masked, iq=iq):
+            for t in range(hp):
+                body(iq, t, masked=masked)
+
+        if not causal:
+            run(False)
+            continue
+        # needed: the block's last row reaches its first column; full:
+        # every element valid. k_start is dynamic (program id), so both
+        # predicates are runtime branches on otherwise-static bodies.
+        needed = q_start + block_q - 1 >= k_start
+        full = q_start >= k_start + block_k - 1
+        pl.when(full)(functools.partial(run, False))
+        pl.when(needed & jnp.logical_not(full))(functools.partial(run, True))
+    for t in range(hp):
+        dk = dk_scrs[t][...]
+        dv = dv_scrs[t][...]
+        if fuse_rope:
+            # dk leaves the kernel already un-rotated (the rotation's
+            # transpose applied in VMEM) — no external f32
+            # read-modify-write pass.
+            cos_k = cos_ref[pl.ds(k_start, block_k), :]
+            sin_k = sin_ref[pl.ds(k_start, block_k), :]
+            dk = _unrotate_grad(dk, cos_k, sin_k)
+        if dropout_rate > 0.0:
+            dv = dv / (1.0 - dropout_rate)
+        dk_ref[0, :, pl.ds(t * d, d)] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, pl.ds(t * d, d)] = dv.astype(dv_ref.dtype)
 
     if fuse_rope:
         # dq finishes accumulating at the last kv grid step (its block index
@@ -412,21 +641,34 @@ def _bwd_fused_kernel(
         # VMEM-resident): un-rotate it in place before it is written back.
         @pl.when(ik == pl.num_programs(2) - 1)
         def _unrotate_dq():
-            dq = dq_ref[0, 0, :, :]
-            dq_ref[0, 0, :, :] = _unrotate_grad(
-                dq, cos_ref[...], sin_ref[...]
-            ).astype(dq_ref.dtype)
+            for t in range(hp):
+                dq = dq_ref[0, :, pl.ds(t * d, d)]
+                dq_ref[0, :, pl.ds(t * d, d)] = _unrotate_grad(
+                    dq, cos_ref[...], sin_ref[...]
+                ).astype(dq_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
-                    block_k, interpret, dropout_rate, dlse=None):
-    b, h, s, d = q.shape
-    kvh = k.shape[1]
+def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
+                    head_dim, num_kv_heads, causal, block_q, block_k,
+                    interpret, dropout_rate, dlse=None,
+                    f32_kv_grads=False):
+    # Folded operands throughout (see _flash_forward). The backward runs
+    # its own block sizes: measured on v5e the backward is MXU/FLOP-bound
+    # (5 dots per block, no online-softmax rescan), so causal block
+    # skipping beats the forward's single-block fast path — 512x512 blocks
+    # compute 3/4 of the score square instead of all of it.
+    # ``num_kv_heads`` here is the KERNEL-level kv-head count: the caller
+    # (_make_flash) expands grouped K/V to per-query-head copies before
+    # pairing heads, and performs the dk/dv group-sum afterwards.
+    b, s, _ = q3.shape
+    h, d = num_heads, head_dim
+    kvh = num_kv_heads
     group = h // kvh
     scale = 1.0 / math.sqrt(d)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term.
-    delta = jnp.einsum(
-        "bhsd,bhsd->bhs", do.astype(jnp.float32), o.astype(jnp.float32)
+    delta = jnp.moveaxis(
+        (do3.astype(jnp.float32) * o3.astype(jnp.float32))
+        .reshape(b, s, h, d).sum(axis=-1), 1, 2
     )[:, :, None, :]
     if dlse is not None:
         # lse is an exposed output (return_lse path): its cotangent enters
@@ -434,13 +676,20 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
         # of the delta row — no kernel change needed.
         delta = delta - dlse.astype(jnp.float32)[:, :, None, :]
 
+    from jax.experimental.pallas import tpu as pltpu
+
+    hp = _heads_per_program(d, interpret)
+    assert group == 1 or hp == 1, "caller expands K/V before pairing heads"
     seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
-    blk = lambda n: pl.BlockSpec((1, 1, n, d), lambda ib, ih, i: (ib, ih, i, 0))
+    blk = lambda n: pl.BlockSpec((1, n, hp * d),
+                                 lambda ib, ip, i: (ib, i, ip))
     kv_blk = lambda n: pl.BlockSpec(
-        (1, 1, n, d), lambda ib, ih, i: (ib, ih // group, i, 0)
+        (1, n, hp * d),
+        (lambda ib, ip, i: (ib, i, ip)) if hp > 1 or group == 1
+        else (lambda ib, ip, i: (ib, i, ip // group)),
     )
-    full = pl.BlockSpec((1, 1, s, d), lambda ib, ih, i: (ib, ih, 0, 0))
-    row = pl.BlockSpec((1, 1, 1, s), lambda ib, ih, i: (ib, ih, 0, 0))
+    full = pl.BlockSpec((1, s, hp * d), lambda ib, ip, i: (ib, 0, ip))
+    row = pl.BlockSpec((1, hp, 1, s), lambda ib, ip, i: (ib, ip, 0, 0))
     fuse_rope = rope is not None
     rope_args = tuple(rope) if fuse_rope else ()
 
@@ -448,33 +697,40 @@ def _flash_backward(q, k, v, o, lse, do, seed_f, rope, *, causal, block_q,
     # (its block index is constant in that dimension, so it stays in VMEM).
     # Under fused rope, dq and dk are un-rotated *inside* the kernel (VMEM)
     # before they are written — no external pass over the gradients.
-    # Under GQA each query head writes per-head dk/dv partials ([b, h, ...],
-    # the same size MHA's dk/dv would be). The partials leave the kernel in
-    # f32 so the group-sum accumulates at full precision and rounds to the
-    # storage dtype exactly once, after the reduction — not once per
-    # partial (the [b, h, s, d] f32 footprint is the same one the MHA dq
-    # already pays).
-    kv_grad_dtype = jnp.float32 if group > 1 else k.dtype
+    # Under GQA (hp == 1 path) each query head writes per-head dk/dv
+    # partials ([b, s, h*d], the same size MHA's dk/dv would be). The
+    # partials leave the kernel in f32 so the caller's group-sum
+    # accumulates at full precision and rounds to the storage dtype
+    # exactly once, after the reduction — not once per partial (the
+    # [b, s, h*d] f32 footprint is the same one the MHA dq already pays).
+    kv_grad_dtype = (jnp.float32 if group > 1 or f32_kv_grads
+                     else k3.dtype)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, block_q=block_q, scale=scale,
                           causal=causal, dropout_rate=dropout_rate,
-                          fuse_rope=fuse_rope),
-        grid=(b, h, s // block_k),
+                          fuse_rope=fuse_rope, hw_prng=not interpret, hp=hp),
+        grid=(b, h // hp, s // block_k),
         in_specs=[_seed_spec(), full, kv_blk(block_k), kv_blk(block_k), full,
                   row, row]
         + (_rope_specs(s, d) if fuse_rope else []),
         out_specs=[full, blk(block_k), blk(block_k)],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, s, d), kv_grad_dtype),
-            jax.ShapeDtypeStruct((b, h, s, d), kv_grad_dtype),
+            jax.ShapeDtypeStruct((b, s, h * d), jnp.float32),
+            jax.ShapeDtypeStruct((b, s, h * d), kv_grad_dtype),
+            jax.ShapeDtypeStruct((b, s, h * d), kv_grad_dtype),
         ],
+        scratch_shapes=(
+            [pltpu.VMEM((block_k, d), jnp.float32)] * (2 * hp)
+        ),
         interpret=interpret,
-    )(seed_f, q, k, v, do, lse, delta, *rope_args)
+    )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args)
     if group > 1:
-        dk = dk.reshape(b, kvh, group, s, d).sum(axis=2).astype(k.dtype)
-        dv = dv.reshape(b, kvh, group, s, d).sum(axis=2).astype(v.dtype)
-    return dq.astype(q.dtype), dk, dv
+        # hp == 1 GQA-by-index-map: reduce per-query-head partials here.
+        dk = dk.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
+            b, s, kvh * d).astype(k3.dtype)
+        dv = dv.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
+            b, s, kvh * d).astype(v3.dtype)
+    return dq.astype(q3.dtype), dk, dv
 
 
 # --------------------------------------------------------------------------
@@ -489,34 +745,91 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
                 num_kv_heads: Optional[int] = None):
     """custom_vjp'd kernel entry over *folded* ``[b, s, h*d]`` operands.
 
-    The fold matters for memory: with head_dim 64, BSHD/BHSD tensors pad
-    their minor dim to the 128-lane tile (2x expansion on every saved
-    activation — q/k/v/o per layer). Saving residuals as ``[b, s, h*d]``
+    The fold matters twice. Memory: with head_dim 64, BSHD/BHSD tensors
+    pad their minor dim to the 128-lane tile (2x expansion on every saved
+    activation — q/k/v/o per layer); saving residuals as ``[b, s, h*d]``
     keeps the minor dim at hidden size, so the autodiff-saved buffers are
-    unpadded; the BHSD form the kernels need exists only transiently around
-    the pallas calls. With ``fuse_rope``, residuals are additionally
-    *pre-rotation* — the rotated q/k never exist outside VMEM.
+    unpadded. Copies: the kernels' BlockSpecs slice per-head ``[*, d]``
+    column blocks straight out of the folded layout, so no BSHD transpose
+    ever materializes in HBM (round 2 paid a layout copy per operand per
+    layer around every pallas call). With ``fuse_rope``, residuals are
+    additionally *pre-rotation* — the rotated q/k never exist outside
+    VMEM.
+
+    The backward uses its own block sizes (``_BWD_BLOCK``): it is
+    FLOP-bound (5 dots per block pair, no online-softmax rescan), so
+    causal block-skipping at 512 beats the forward's single-block layout.
     """
-    kw = dict(causal=causal, block_q=block_q, block_k=block_k,
-              interpret=interpret, dropout_rate=dropout_rate)
     h, d = num_heads, head_dim
     kvh = num_kv_heads if num_kv_heads is not None else h
+    group = h // kvh
+    hp = _heads_per_program(d, interpret)
+    # A paired program's two query heads may straddle a K/V head boundary,
+    # so under hp > 1 grouped K/V expands to per-query-head copies (the
+    # repeated-KV-MHA identity) before the kernels, and dk/dv group-sum
+    # back afterwards (in f32 — one rounding after the reduction).
+    expand_kv = group > 1 and hp > 1
+    kernel_kvh = h if expand_kv else kvh
+    kw = dict(causal=causal, block_q=block_q, block_k=block_k,
+              interpret=interpret, dropout_rate=dropout_rate,
+              num_heads=h, head_dim=d, num_kv_heads=kernel_kvh)
+    bwd_kw = dict(kw, f32_kv_grads=expand_kv)
+    # The backward takes its preferred block shape. Safe under dropout
+    # too: hardware-PRNG masks generate in fixed 512x512 tiles keyed by
+    # absolute coordinates (see _keep), so any pair of 512-divisible (or
+    # equal) fwd/bwd block shapes sees identical masks — the overrides
+    # below only fire when blocks are 512-divisible. 512x512 wins for the
+    # backward with or without dropout: causal block-skipping computes
+    # 3/4 of the score square, and the paired program's f32 [bq, bk]
+    # working set stays inside the 16 MB scoped-VMEM budget (single
+    # 1024x1024 blocks blow it).
+    bwd_kw["block_q"] = (_BWD_BLOCK if block_q % _BWD_BLOCK == 0
+                         else block_q)
+    bwd_kw["block_k"] = (_BWD_BLOCK if block_k % _BWD_BLOCK == 0
+                         else block_k)
 
-    def to_bhsd(x3, heads=h):
+    def _expand(x3):
+        if not expand_kv:
+            return x3
         b, s, _ = x3.shape
-        return x3.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+        return jnp.broadcast_to(
+            x3.reshape(b, s, kvh, 1, d), (b, s, kvh, group, d)
+        ).reshape(b, s, h * d)
 
-    def to_flat(x4):
-        b, nh, s, _ = x4.shape
-        return x4.transpose(0, 2, 1, 3).reshape(b, s, nh * d)
+    def _group_sum(g3, like):
+        if not expand_kv:
+            return g3
+        b, s, _ = g3.shape
+        return g3.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
+            b, s, kvh * d).astype(like.dtype)
 
     def _fwd(q3, k3, v3, seed_f, cos, sin):
+        # Returns (o3, lse, qr3, kr3): under fuse_rope the kernel emits the
+        # rotated-scaled q and rotated k, which replace the raw q3/k3 in
+        # the autodiff residuals so the backward never re-rotates per
+        # block; without rope qr3/kr3 are None.
         rope = (cos, sin) if fuse_rope else None
-        o, lse = _flash_forward(
-            to_bhsd(q3), to_bhsd(k3, kvh), to_bhsd(v3, kvh), seed_f, rope,
-            **kw
+        return _flash_forward(q3, _expand(k3), _expand(v3), seed_f, rope,
+                              **kw)
+
+    def _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, cos, sin):
+        if fuse_rope:
+            return (qr3, kr3, v3, o3, lse, seed_f, cos, sin)
+        return (q3, k3, v3, o3, lse, seed_f, cos, sin)
+
+    def _bwd_impl(res, do3, dlse=None):
+        qs3, ks3, v3, o3, lse, seed_f, cos, sin = res
+        rope = (cos, sin) if fuse_rope else None
+        # Under fuse_rope, ks3 is the kernel-width rotated k the forward
+        # wrote (already expanded for GQA); otherwise expand the raw k3.
+        kx3 = ks3 if fuse_rope else _expand(ks3)
+        dq, dk, dv = _flash_backward(
+            qs3, kx3, _expand(v3), o3, lse, do3, seed_f, rope,
+            dlse=dlse, **bwd_kw
         )
-        return to_flat(o), lse
+        return (dq, _group_sum(dk, v3), _group_sum(dv, v3),
+                jnp.zeros_like(seed_f), jnp.zeros_like(cos),
+                jnp.zeros_like(sin))
 
     if return_lse:
         # (o, lse [b, h, s]) variant for blockwise composition (ring
@@ -525,25 +838,17 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         # backward's delta row, see _flash_backward).
         @jax.custom_vjp
         def flash(q3, k3, v3, seed_f, cos, sin):
-            o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)
+            o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)[:2]
             return o3, lse[:, :, 0, :]
 
         def fwd(q3, k3, v3, seed_f, cos, sin):
-            o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)
-            return (o3, lse[:, :, 0, :]), (q3, k3, v3, o3, lse, seed_f, cos, sin)
+            o3, lse, qr3, kr3 = _fwd(q3, k3, v3, seed_f, cos, sin)
+            return ((o3, lse[:, :, 0, :]),
+                    _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, cos, sin))
 
         def bwd(res, cot):
             do3, dlse = cot
-            q3, k3, v3, o3, lse, seed_f, cos, sin = res
-            rope = (cos, sin) if fuse_rope else None
-            dq, dk, dv = _flash_backward(
-                to_bhsd(q3), to_bhsd(k3, kvh), to_bhsd(v3, kvh),
-                to_bhsd(o3), lse, to_bhsd(do3), seed_f, rope, dlse=dlse,
-                **kw
-            )
-            return (to_flat(dq), to_flat(dk), to_flat(dv),
-                    jnp.zeros_like(seed_f), jnp.zeros_like(cos),
-                    jnp.zeros_like(sin))
+            return _bwd_impl(res, do3, dlse=dlse)
 
         flash.defvjp(fwd, bwd)
         return flash
@@ -553,19 +858,11 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         return _fwd(q3, k3, v3, seed_f, cos, sin)[0]
 
     def fwd(q3, k3, v3, seed_f, cos, sin):
-        o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)
-        return o3, (q3, k3, v3, o3, lse, seed_f, cos, sin)
+        o3, lse, qr3, kr3 = _fwd(q3, k3, v3, seed_f, cos, sin)
+        return o3, _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, cos, sin)
 
     def bwd(res, do3):
-        q3, k3, v3, o3, lse, seed_f, cos, sin = res
-        rope = (cos, sin) if fuse_rope else None
-        dq, dk, dv = _flash_backward(
-            to_bhsd(q3), to_bhsd(k3, kvh), to_bhsd(v3, kvh), to_bhsd(o3),
-            lse, to_bhsd(do3), seed_f, rope, **kw
-        )
-        return (to_flat(dq), to_flat(dk), to_flat(dv),
-                jnp.zeros_like(seed_f), jnp.zeros_like(cos),
-                jnp.zeros_like(sin))
+        return _bwd_impl(res, do3)
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -604,20 +901,28 @@ def flash_attention(
         raise ValueError(
             f"num_heads {h} not divisible by num_kv_heads {k.shape[2]}"
         )
-    if return_lse and (s % 128 != 0 or s < 128):
+    if return_lse and (s % 128 != 0 or s < 128
+                       or not (interpret or d == 64 or d % 128 == 0)):
         # The lse variant exists for blockwise composition (ring attention);
         # its callers check tiling first, so this is a programming error.
         raise NotImplementedError(
-            f"return_lse requires a kernel-tileable sequence (s={s})"
+            f"return_lse requires a kernel-tileable sequence/head_dim "
+            f"(s={s}, d={d})"
         )
     # Largest block <= the requested size that divides the sequence, so e.g.
     # seq=768 runs the kernel with 256-blocks rather than falling back to
-    # the O(seq^2) path.
-    block_q = next((blk for blk in (block_q, 256, 128) if blk <= s and s % blk == 0),
-                   block_q)
-    block_k = next((blk for blk in (block_k, 256, 128) if blk <= s and s % blk == 0),
-                   block_k)
-    if s % block_q != 0 or s % block_k != 0 or s < 8:
+    # the O(seq^2) path. (Dropout masks generate in fixed 512x512 tiles
+    # keyed by absolute coordinates — see _keep — so the backward's
+    # different block shape still sees the identical mask.)
+    block_q = next((blk for blk in (block_q, 512, 256, 128)
+                    if blk <= s and s % blk == 0), block_q)
+    block_k = next((blk for blk in (block_k, 512, 256, 128)
+                    if blk <= s and s % blk == 0), block_k)
+    # Compiled Mosaic lowering supports d=64 (two heads per program, lane
+    # width 128) and d multiples of 128; other head dims take the XLA
+    # fallback below (interpret mode has no lane constraint).
+    kernel_ok = interpret or d == 64 or d % 128 == 0
+    if s % block_q != 0 or s % block_k != 0 or s < 8 or not kernel_ok:
         if rope is not None:
             from tpu_trainer.ops.rope import apply_rotary_pos_emb
 
@@ -655,17 +960,35 @@ def flash_attention(
     else:
         cos = sin = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
     kvh = k.shape[2]
+    h_k = h
+    if not interpret and d == 64 and h % 2 == 1:
+        # Head pairing needs an even head count (e.g. gpt2-xl's 25 heads):
+        # expand grouped K/V to per-query-head copies, then append one
+        # all-zero head. Zero q/k give uniform scores (finite lse, finite
+        # backward); zero dO upstream keeps its gradients zero. The pad and
+        # the expansion sit outside the custom_vjp, so their VJPs
+        # (slice/group-sum) are ordinary autodiff.
+        if kvh != h:
+            k = jnp.broadcast_to(k[:, :, :, None, :],
+                                 (b, s, kvh, h // kvh, d)).reshape(b, s, h, d)
+            v = jnp.broadcast_to(v[:, :, :, None, :],
+                                 (b, s, kvh, h // kvh, d)).reshape(b, s, h, d)
+        zpad = jnp.zeros((b, s, 1, d), q.dtype)
+        q = jnp.concatenate([q, zpad], axis=2)
+        k = jnp.concatenate([k, zpad.astype(k.dtype)], axis=2)
+        v = jnp.concatenate([v, zpad.astype(v.dtype)], axis=2)
+        h_k = h + 1
+        kvh = h_k
     fn = _make_flash(
-        causal, block_q, block_k, interpret, float(dropout_rate), h, d,
+        causal, block_q, block_k, interpret, float(dropout_rate), h_k, d,
         fuse_rope, return_lse, kvh,
     )
-    # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals);
-    # the kernel-internal layout is BHSD for the (seq, head_dim) tiling.
+    # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals).
     out = fn(
-        q.reshape(b, s, h * d), k.reshape(b, s, kvh * d),
+        q.reshape(b, s, h_k * d), k.reshape(b, s, kvh * d),
         v.reshape(b, s, kvh * d), seed_f, cos, sin,
     )
     if return_lse:
         o3, lse = out
-        return o3.reshape(b, s, h, d), lse
-    return out.reshape(b, s, h, d)
+        return o3.reshape(b, s, h_k, d)[:, :, :h], lse[:, :h]
+    return out.reshape(b, s, h_k, d)[:, :, :h]
