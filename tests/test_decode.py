@@ -142,3 +142,85 @@ class TestBucketedGenerate:
         bucketed = generate_bucketed(params, jax.random.PRNGKey(1), ids,
                                      config=CFG, max_new_tokens=4, top_k=1)
         np.testing.assert_array_equal(np.asarray(bucketed), np.asarray(exact))
+
+
+class TestRaggedDecode:
+    """Mixed-length batched decode (VERDICT r2 item 8): one generate_kv
+    call over a right-padded ragged batch must reproduce per-row single
+    calls exactly (greedy — the batched rng stream differs, so top_k=1
+    makes 'exactly' well-defined)."""
+
+    def test_mixed_lengths_match_per_row_calls(self, params):
+        rng = jax.random.PRNGKey(5)
+        lens = [5, 11, 16]
+        width = max(lens)
+        rows = [
+            jax.random.randint(jax.random.fold_in(rng, i), (L,), 0, 128)
+            for i, L in enumerate(lens)
+        ]
+        padded = jnp.stack([
+            jnp.pad(r, (0, width - r.shape[0])) for r in rows
+        ]).astype(jnp.int32)
+        new = 6
+        batch_out = generate_kv(
+            params, rng, padded, config=CFG, max_new_tokens=new,
+            temperature=1.0, top_k=1,
+            prompt_lens=jnp.asarray(lens, jnp.int32),
+        )
+        for i, (L, row) in enumerate(zip(lens, rows)):
+            single = generate_kv(
+                params, rng, row[None].astype(jnp.int32), config=CFG,
+                max_new_tokens=new, temperature=1.0, top_k=1,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(batch_out)[i, :L + new],
+                np.asarray(single)[0],
+                err_msg=f"row {i} (len {L})",
+            )
+            # Beyond each row's real tokens: zero fill.
+            assert np.all(np.asarray(batch_out)[i, L + new:] == 0)
+
+    def test_uniform_lengths_unchanged_by_prompt_lens(self, params):
+        rng = jax.random.PRNGKey(6)
+        ids = jax.random.randint(rng, (2, 12), 0, 128)
+        a = generate_kv(params, rng, ids, config=CFG, max_new_tokens=4,
+                        top_k=1)
+        b = generate_kv(params, rng, ids, config=CFG, max_new_tokens=4,
+                        top_k=1,
+                        prompt_lens=jnp.full((2,), 12, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestShardedDecode:
+    """Data- and tensor-sharded generate_kv on the fake 8-device mesh
+    (VERDICT r2 item 8: the reference decodes batch-of-one on one device;
+    here decode is just another consumer of the training shardings)."""
+
+    def test_sharded_matches_unsharded(self, params):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_trainer.parallel import sharding as shard_lib
+        from tpu_trainer.parallel.mesh import (
+            DATA_AXIS, MeshConfig, make_mesh,
+        )
+
+        rng = jax.random.PRNGKey(9)
+        ids = jax.random.randint(rng, (4, 12), 0, 128)
+        want = generate_kv(params, rng, ids, config=CFG, max_new_tokens=5,
+                           top_k=1)
+
+        mesh = make_mesh(MeshConfig(data=4, fsdp=1, tensor=2))
+        sharded_params = jax.device_put(
+            params,
+            shard_lib.to_shardings(
+                shard_lib.params_specs(params, mesh, "replicated"), mesh
+            ),
+        )
+        ids_sharded = jax.device_put(
+            ids, NamedSharding(mesh, P(DATA_AXIS, None))
+        )
+        got = jax.jit(
+            lambda pp, rr, ii: generate_kv(pp, rr, ii, config=CFG,
+                                           max_new_tokens=5, top_k=1)
+        )(sharded_params, rng, ids_sharded)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

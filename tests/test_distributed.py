@@ -212,3 +212,53 @@ class TestShardingSpecs:
         full = sum(l.size * l.dtype.itemsize
                    for l in jax.tree_util.tree_leaves(s3.params))
         assert local_bytes(s3.params) <= full / 8 + 1024
+
+
+class TestHostFeedInfo:
+    """Multi-host data feeding from the mesh's row coverage (VERDICT r2
+    item 6): hosts under a sequence/tensor axis spanning hosts share a feed
+    rank (replicated rows); data/fsdp hosts get disjoint ranks. Simulated
+    multi-host layouts via the injectable device->process map."""
+
+    def _info(self, mesh_cfg, n_proc, pidx, rows=16):
+        from tpu_trainer.parallel.mesh import batch_sharding, host_feed_info
+
+        mesh = make_mesh(mesh_cfg)
+        n_dev = mesh.size
+        assert n_dev % n_proc == 0
+        per = n_dev // n_proc
+        pod = lambda d: d.id // per
+        return host_feed_info(
+            batch_sharding(mesh), (1, rows, 8), row_dim=1,
+            process_of_device=pod, process_index=pidx,
+        )
+
+    def test_disjoint_data_hosts(self):
+        # data=8 over 4 "hosts" of 2 devices: classic disjoint feeding.
+        ranks = [self._info(MeshConfig(data=8), 4, p) for p in range(4)]
+        assert ranks == [(0, 4), (1, 4), (2, 4), (3, 4)]
+
+    def test_sequence_axis_spanning_hosts(self):
+        # data=2 x sequence=4 over 4 hosts: host pairs share a data shard.
+        cfg = MeshConfig(data=2, fsdp=1, sequence=4)
+        ranks = [self._info(cfg, 4, p) for p in range(4)]
+        assert ranks == [(0, 2), (0, 2), (1, 2), (1, 2)]
+
+    def test_all_hosts_replicated(self):
+        # pure sequence parallelism: every host loads the same rows.
+        cfg = MeshConfig(data=1, fsdp=1, sequence=8)
+        ranks = [self._info(cfg, 4, p) for p in range(4)]
+        assert ranks == [(0, 1)] * 4
+
+    def test_interleaved_layout_rejected(self):
+        from tpu_trainer.parallel.mesh import batch_sharding, host_feed_info
+
+        mesh = make_mesh(MeshConfig(data=8))
+        pod = lambda d: d.id % 2  # host 0 gets every other data shard
+        with pytest.raises(ValueError, match="not contiguous"):
+            host_feed_info(batch_sharding(mesh), (1, 16, 8), row_dim=1,
+                           process_of_device=pod, process_index=0)
+
+    def test_trainer_single_process_degenerates(self):
+        trainer = make_trainer(MeshConfig(data=-1), "replicated")
+        assert (trainer.data_feed_rank, trainer.data_feed_world) == (0, 1)

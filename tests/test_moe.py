@@ -27,6 +27,9 @@ MOE_TINY = GPTConfig(
     max_seq_len=32, dropout=0.0, attention_dropout=0.0,
     use_flash_attention=False, dtype="float32",
     num_experts=4, expert_capacity_factor=2.0,
+    # aux weight 1.0 so layer tests read the raw load-balance value (the
+    # layer returns its auxiliaries pre-weighted).
+    moe_aux_weight=1.0,
 )
 
 
@@ -73,6 +76,83 @@ class TestMoELayer:
         )["params"]
         actual = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
         assert got == actual
+
+    def test_top2_routing_uses_two_experts_per_token(self):
+        # With k=2 and generous capacity, every token's output is a convex
+        # combination over TWO experts: perturbing either chosen expert's
+        # weights changes the output. Cheap proxy: zeroing the gates of the
+        # top-1 expert alone must NOT zero the token (the second choice
+        # still contributes), unlike top-1 routing.
+        cfg = dataclasses.replace(MOE_TINY, moe_top_k=2)
+        x = jax.random.normal(jax.random.PRNGKey(11), (1, 16, 32))
+        (out2, aux2), params = self._layer_out(cfg, x)
+        assert out2.shape == x.shape
+        assert np.isfinite(np.asarray(out2)).all()
+        assert np.isfinite(float(aux2))
+        # Gates renormalize over the pair: output magnitude stays in the
+        # same ballpark as top-1 (not halved).
+        (out1, _), _ = self._layer_out(MOE_TINY, x)
+        r = float(jnp.linalg.norm(out2) / jnp.linalg.norm(out1))
+        assert 0.3 < r < 3.0, r
+
+    def test_top2_capacity_drops_second_choices_first(self):
+        # C=1 at k=2: first choices occupy the slots in token order; the
+        # contribution that survives for early tokens is their first
+        # choice. Compare against k=1 at C=1: identical kept dispatch for
+        # tokens whose first choice got a slot.
+        cfg1 = dataclasses.replace(MOE_TINY, expert_capacity_factor=1e-9)
+        cfg2 = dataclasses.replace(cfg1, moe_top_k=2)
+        x = jax.random.normal(jax.random.PRNGKey(12), (1, 32, 32))
+        layer1, layer2 = MoEMLP(cfg1), MoEMLP(cfg2)
+        params = layer1.init(jax.random.PRNGKey(0), x)["params"]
+        out1, _ = layer1.apply({"params": params}, x)
+        out2, _ = layer2.apply({"params": params}, x)
+        # Token 0's first choice always holds slot 0 of its expert; with
+        # renormalized gates its k=2 output differs in scale but must be
+        # nonzero in both.
+        assert np.any(np.asarray(out1)[0, 0] != 0.0)
+        assert np.any(np.asarray(out2)[0, 0] != 0.0)
+
+    def test_router_z_loss_added_and_differentiable(self):
+        cfg = dataclasses.replace(MOE_TINY, router_z_weight=1.0)
+        x = jax.random.normal(jax.random.PRNGKey(13), (1, 16, 32))
+        (out_z, aux_z), params = self._layer_out(cfg, x)
+        (out0, aux0), _ = self._layer_out(MOE_TINY, x)
+        np.testing.assert_allclose(out_z, out0, atol=0)  # loss-only change
+        assert float(aux_z) > float(aux0)  # z^2 term is positive
+        layer = MoEMLP(cfg)
+
+        def loss(p):
+            _, aux = layer.apply({"params": p}, x)
+            return aux
+
+        g = jax.grad(loss)(params)["router"]["kernel"]
+        assert float(jnp.sum(jnp.abs(g))) > 0.0
+
+    def test_top1_unchanged_by_generalization(self):
+        # The k=1 path must reproduce the round-2 Switch semantics exactly:
+        # gate = raw router prob, same dispatch.
+        x = jax.random.normal(jax.random.PRNGKey(14), (2, 16, 32))
+        layer = MoEMLP(MOE_TINY)
+        params = layer.init(jax.random.PRNGKey(0), x)["params"]
+        out, aux = layer.apply({"params": params}, x)
+        # Oracle: dense per-token computation of the same routing.
+        xt = np.asarray(x).reshape(32, 32)
+        logits = xt @ np.asarray(params["router"]["kernel"])
+        probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        top1 = probs.argmax(-1)
+        wg, wu, wd = (np.asarray(params[k]) for k in
+                      ("experts_gate", "experts_up", "experts_down"))
+        import scipy.special  # noqa: F401  (silu via jax below)
+        silu = lambda a: np.asarray(jax.nn.silu(jnp.asarray(a)))
+        want = np.zeros_like(xt)
+        for t in range(32):
+            e = top1[t]
+            h = silu(xt[t] @ wg[e]) * (xt[t] @ wu[e])
+            want[t] = probs[t, e] * (h @ wd[e])
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(32, 32), want, atol=2e-5
+        )
 
     def test_gradients_flow_to_router_and_experts(self):
         x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 32))
@@ -133,6 +213,59 @@ class TestExpertParallelism:
         assert gate[1] == EXPERT_AXIS
         router = next(v for k, v in flat.items() if "router" in k)
         assert all(a is None for a in router)
+
+    def test_expert_params_ep_x_tp_sharded(self):
+        # EP x TP composes: expert dim over 'expert', FFN dims over
+        # 'tensor' (column-parallel gate/up, row-parallel down) —
+        # VERDICT r2 item 7.
+        from tpu_trainer.parallel.mesh import TENSOR_AXIS
+
+        mesh = make_mesh(MeshConfig(data=1, fsdp=1, expert=2, tensor=2,
+                                    sequence=2))
+        params = jax.eval_shape(
+            lambda rng: GPT(MOE_TINY).init(
+                rng, np.zeros((1, 8), np.int32)
+            )["params"],
+            jax.random.PRNGKey(0),
+        )
+        specs = shard_lib.params_specs(params, mesh, "replicated")
+        flat = {
+            "/".join(shard_lib._path_keys(p)): s
+            for p, s in jax.tree_util.tree_flatten_with_path(specs)[0]
+        }
+        gate = next(v for k, v in flat.items() if "experts_gate" in k)
+        up = next(v for k, v in flat.items() if "experts_up" in k)
+        down = next(v for k, v in flat.items() if "experts_down" in k)
+        # [L, E, H, I]: expert on 1, intermediate on -1 (gate/up) / -2 (down)
+        assert gate[1] == EXPERT_AXIS and gate[-1] == TENSOR_AXIS
+        assert up[1] == EXPERT_AXIS and up[-1] == TENSOR_AXIS
+        assert down[1] == EXPERT_AXIS and down[-2] == TENSOR_AXIS
+
+    def test_ep_x_tp_losses_match_single_shard(self):
+        # EP x TP is still a pure layout change: loss-equal to plain DP.
+        batch = np.random.default_rng(0).integers(0, 128, (8, 32), np.int32)
+
+        def cfg(batch_size):
+            return TrainingConfig(
+                batch_size=batch_size, max_seq_len=32,
+                gradient_accumulation_steps=1, mixed_precision="fp32",
+                warmup_steps=2, max_steps=10,
+            )
+
+        losses = {}
+        for name, mesh_cfg, dp in [
+            ("dp", MeshConfig(data=-1, fsdp=1), 8),
+            ("ep2_tp2", MeshConfig(data=2, fsdp=1, expert=2, tensor=2), 2),
+        ]:
+            trainer = Trainer(
+                MOE_TINY, cfg(8 // dp),
+                ParallelConfig(mesh_cfg, "replicated"),
+            )
+            state = trainer.init_state(seed=0)
+            for _ in range(3):
+                state, metrics = trainer.train_step(state, batch)
+            losses[name] = float(metrics["loss"])
+        assert losses["dp"] == pytest.approx(losses["ep2_tp2"], rel=2e-5)
 
     def test_ep_losses_match_single_shard(self):
         # Identical global batch (8 rows) under every mesh: per-shard

@@ -38,12 +38,13 @@ def _backend_supports_pinned_host() -> bool:
         return False
 
 
-pytestmark = pytest.mark.skipif(
+needs_pinned_host = pytest.mark.skipif(
     not _backend_supports_pinned_host(),
     reason="backend has no pinned_host memory space",
 )
 
 
+@needs_pinned_host
 def test_offload_matches_on_device_losses():
     batch = np.random.default_rng(0).integers(0, 128, (8, 32), np.int32)
     losses = {}
@@ -69,3 +70,62 @@ def test_offload_matches_on_device_losses():
             state, metrics = trainer.train_step(state, batch)
         losses[offload] = float(metrics["loss"])
     assert losses[False] == pytest.approx(losses[True], rel=1e-6)
+
+
+@needs_pinned_host
+def test_offload_bf16_state_dtype_and_training():
+    # offload_dtype=bfloat16 halves the host stream: the stored m/v must be
+    # bf16, and training must still converge-ish (one rounding per step).
+    trainer = Trainer(
+        TINY, TRAIN,
+        ParallelConfig(MeshConfig(data=1, fsdp=-1), "zero3",
+                       cpu_offload=True, offload_dtype="bfloat16"),
+    )
+    state = trainer.init_state(seed=0)
+    dtypes = {
+        x.dtype for x in jax.tree_util.tree_leaves(state.opt_state)
+        if getattr(x, "ndim", 0) >= 1
+        and jnp.issubdtype(x.dtype, jnp.floating)
+    }
+    assert dtypes == {jnp.dtype("bfloat16")}
+    batch = np.random.default_rng(0).integers(0, 128, (8, 32), np.int32)
+    first = None
+    for _ in range(10):
+        state, metrics = trainer.train_step(state, batch)
+        first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first  # still learns
+
+
+class TestOffloadCastHelpers:
+    """The storage/compute casts, independent of pinned_host availability
+    (runs on CPU where offload itself is disabled)."""
+
+    def _trainer(self):
+        return Trainer(TINY, TRAIN,
+                       ParallelConfig(MeshConfig(data=-1), "replicated"))
+
+    def test_roundtrip_dtypes(self):
+        t = self._trainer()
+        t._offload_cast = jnp.dtype("bfloat16")
+        opt = t.optimizer.init(
+            jax.tree_util.tree_map(
+                jnp.zeros_like,
+                t.init_state(seed=0).params,
+            )
+        )
+        stored = t._offload_store(opt)
+        big = [x for x in jax.tree_util.tree_leaves(stored)
+               if getattr(x, "ndim", 0) >= 1
+               and jnp.issubdtype(x.dtype, jnp.floating)]
+        assert {x.dtype for x in big} == {jnp.dtype("bfloat16")}
+        back = t._offload_load(stored)
+        for a, b in zip(jax.tree_util.tree_leaves(opt),
+                        jax.tree_util.tree_leaves(back)):
+            assert a.dtype == b.dtype
+
+    def test_noop_without_cast(self):
+        t = self._trainer()
+        assert t._offload_cast is None
+        opt = {"x": jnp.ones((4, 4))}
+        assert t._offload_store(opt) is opt
+        assert t._offload_load(opt) is opt

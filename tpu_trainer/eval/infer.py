@@ -51,6 +51,13 @@ def main(argv=None) -> int:
                    help="use the windowed full-forward sampler (the "
                         "reference's O(S^2) semantics) instead of the "
                         "KV-cached decoder")
+    p.add_argument("--prompt_file", default=None,
+                   help="file with one prompt per line: decoded as ONE "
+                        "ragged batch (per-row lengths; KV cache path)")
+    p.add_argument("--mesh_data", type=int, default=1,
+                   help="shard batch rows over a data mesh axis")
+    p.add_argument("--mesh_tensor", type=int, default=1,
+                   help="Megatron-style tensor-parallel decode")
     args = p.parse_args(argv)
 
     if args.device == "cpu":
@@ -76,34 +83,89 @@ def main(argv=None) -> int:
     config = dataclasses.replace(config, dropout=0.0, attention_dropout=0.0)
 
     tokenizer = get_tokenizer(args.tokenizer)
-    ids = tokenizer.encode(args.prompt)
-    if not ids:
-        ids = [min(tokenizer.eos_token_id, config.vocab_size - 1)]
-    if max(ids) >= config.vocab_size:
+    if args.prompt_file:
+        with open(args.prompt_file) as f:
+            prompts = [ln.rstrip("\n") for ln in f if ln.strip()]
+        if not prompts:
+            p.error(f"no prompts in {args.prompt_file}")
+    else:
+        prompts = [args.prompt]
+    eos = min(tokenizer.eos_token_id, config.vocab_size - 1)
+    rows = [tokenizer.encode(pr) or [eos] for pr in prompts]
+    top = max(max(r) for r in rows)
+    if top >= config.vocab_size:
         p.error(
-            f"prompt tokenizes to id {max(ids)} but the checkpoint's model has "
+            f"prompt tokenizes to id {top} but the checkpoint's model has "
             f"vocab_size {config.vocab_size} — tokenizer/model mismatch "
             f"(tokenizer: {tokenizer.name})"
         )
-    input_ids = jnp.asarray(ids, jnp.int32)[None, :]
+    lens = [len(r) for r in rows]
+    width = max(lens)
+    input_ids = jnp.asarray(
+        [r + [0] * (width - len(r)) for r in rows], jnp.int32
+    )
+    prompt_lens = (jnp.asarray(lens, jnp.int32)
+                   if len(set(lens)) > 1 else None)
 
     # KV-cached decode (O(S) per token) when the result fits the cache;
     # the windowed full-forward path handles overflow and --no_kv_cache.
-    fits = input_ids.shape[1] + args.max_new_tokens <= config.max_seq_len
-    # The fallback path buckets its compile shapes: repeated prompts of
-    # different lengths share one XLA compile (models/gpt.py).
-    sampler = generate_kv if (fits and not args.no_kv_cache) else generate_bucketed
-    out = sampler(
-        params,
-        jax.random.PRNGKey(args.seed),
-        input_ids,
-        config=config,
-        max_new_tokens=args.max_new_tokens,
-        temperature=args.temperature,
-        top_k=args.top_k,
-    )
-    text = tokenizer.decode(list(out[0]))
-    print(text)
+    fits = width + args.max_new_tokens <= config.max_seq_len
+    use_kv = fits and not args.no_kv_cache
+    if prompt_lens is not None and not use_kv:
+        p.error("ragged multi-prompt decode needs the KV path: shorten "
+                "--max_new_tokens to fit max_seq_len, or drop --no_kv_cache")
+
+    n_shards = args.mesh_data * args.mesh_tensor
+    if n_shards > 1 and not use_kv:
+        p.error("sharded decode uses the KV path: shorten --max_new_tokens "
+                "to fit max_seq_len, or drop --no_kv_cache")
+    if n_shards > 1:
+        # Sharded decode: batch rows over `data`, Megatron TP over
+        # `tensor` (the training param rules reused verbatim — decode is
+        # just another consumer of the same layout).
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpu_trainer.parallel import sharding as shard_lib
+        from tpu_trainer.parallel.mesh import (
+            DATA_AXIS, MeshConfig, make_mesh,
+        )
+
+        if len(prompts) % args.mesh_data != 0:
+            p.error(f"{len(prompts)} prompts not divisible by "
+                    f"--mesh_data {args.mesh_data}")
+        mesh = make_mesh(MeshConfig(data=args.mesh_data, fsdp=1,
+                                    tensor=args.mesh_tensor))
+        params = jax.device_put(
+            params,
+            shard_lib.to_shardings(
+                shard_lib.params_specs(params, mesh, "replicated"), mesh
+            ),
+        )
+        row_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        input_ids = jax.device_put(
+            input_ids, NamedSharding(mesh, P(DATA_AXIS, None))
+        )
+        if prompt_lens is not None:
+            prompt_lens = jax.device_put(prompt_lens, row_sharding)
+
+    sampler = generate_kv if use_kv else generate_bucketed
+    kwargs = dict(config=config, max_new_tokens=args.max_new_tokens,
+                  temperature=args.temperature, top_k=args.top_k)
+    if use_kv and prompt_lens is not None:
+        kwargs["prompt_lens"] = prompt_lens
+    if n_shards > 1:
+        out = jax.jit(
+            lambda pp, rr, ii: generate_kv(pp, rr, ii, **kwargs)
+        )(params, jax.random.PRNGKey(args.seed), input_ids)
+    else:
+        out = sampler(
+            params, jax.random.PRNGKey(args.seed), input_ids, **kwargs
+        )
+    out = jax.device_get(out)
+    for i, L in enumerate(lens):
+        n_real = L + args.max_new_tokens if use_kv else out.shape[1]
+        text = tokenizer.decode(list(out[i, :n_real]))
+        print(text)
     return 0
 
 

@@ -71,12 +71,18 @@ class GPTConfig:
     rope_theta: float = 10000.0
 
     # Mixture-of-Experts (0 = dense; beyond-reference model family). When
-    # num_experts > 0 every block's feed-forward becomes a Switch-style
-    # top-1 routed expert SwiGLU (models/moe.py), with experts shardable
-    # over the mesh's 'expert' axis.
+    # num_experts > 0 every block's feed-forward becomes a routed expert
+    # SwiGLU (models/moe.py): Switch-style top-1 by default, GShard-style
+    # top-2 (renormalized gates, first-choice priority at capacity) with
+    # moe_top_k=2. Experts shard over the mesh's 'expert' axis AND their
+    # FFN dims over 'tensor' (EP x TP composes). router_z_weight adds the
+    # ST-MoE router z-loss (mean logsumexp^2 of router logits — keeps
+    # logits from drifting to magnitudes where softmax saturates).
     num_experts: int = 0
+    moe_top_k: int = 1
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    router_z_weight: float = 0.0
 
     # Optimization flags (reference config.py:30-32)
     use_flash_attention: bool = False
@@ -156,6 +162,13 @@ class GPTConfig:
             assert self.num_heads % self.num_kv_heads == 0, (
                 f"num_heads ({self.num_heads}) must be divisible by "
                 f"num_kv_heads ({self.num_kv_heads})"
+            )
+        if self.num_experts > 0 and not (
+            1 <= self.moe_top_k <= self.num_experts
+        ):
+            raise ValueError(
+                f"moe_top_k ({self.moe_top_k}) must be in "
+                f"[1, num_experts={self.num_experts}]"
             )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(

@@ -36,6 +36,7 @@ from typing import Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.ops import ring
@@ -169,12 +170,23 @@ class CausalSelfAttention(nn.Module):
         ci = self.variable(
             "cache", "idx", lambda: jnp.zeros((), jnp.int32)
         )
+        # Per-row left-pad sizes for ragged batches (generate_kv left-pads
+        # mixed-length prompts to a shared frontier): row r's positions
+        # < pad[r] are padding — excluded from attention windows and from
+        # RoPE position counting. All-zero (the default) is exactly the
+        # uniform-length behavior.
+        cp = self.variable(
+            "cache", "pad", lambda: jnp.zeros((b,), jnp.int32)
+        )
         idx = ci.value
+        pad = cp.value
 
         cos, sin = rope_tables(max_len, d, cfg.rope_theta)
-        cos_s = jax.lax.dynamic_slice(cos, (idx, 0), (s, d))
-        sin_s = jax.lax.dynamic_slice(sin, (idx, 0), (s, d))
-        q, k = apply_rotary_pos_emb(q, k, cos_s, sin_s)
+        # Logical (post-pad) positions per row; clamped at 0 for the pad
+        # region itself (whose outputs are never read).
+        gpos = idx + jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        lpos = jnp.maximum(gpos - pad[:, None], 0)              # [b, s]
+        q, k = apply_rotary_pos_emb(q, k, cos[lpos], sin[lpos])
 
         k_all = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
         v_all = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
@@ -193,9 +205,16 @@ class CausalSelfAttention(nn.Module):
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * scale
         q_pos = idx + jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
-        allowed = k_pos <= q_pos
+        # Causal, excluding each row's left padding. Pad-region queries keep
+        # their self position so their (never-read) softmax rows stay
+        # finite — an empty window would put NaN into this position's
+        # residual stream and poison later layers' cached K/V.
+        allowed = (k_pos[None] <= q_pos[None]) & (
+            (k_pos[None] >= pad[:, None, None])
+            | (k_pos[None] == q_pos[None])
+        )
         scores = jnp.where(
-            allowed[None, None], scores, jnp.finfo(scores.dtype).min
+            allowed[:, None], scores, jnp.finfo(scores.dtype).min
         )
         weights = jax.nn.softmax(
             scores.astype(jnp.float32), axis=-1
@@ -456,8 +475,10 @@ class GPT(nn.Module):
                     optax_softmax_cross_entropy(logits[:, :-1, :], labels[:, 1:])
                 )
             if cfg.num_experts > 0:
-                # MoE load-balance auxiliary (mean over layers).
-                loss = loss + cfg.moe_aux_weight * moe_aux / cfg.num_layers
+                # MoE auxiliaries (mean over layers). The layer returns them
+                # pre-weighted: moe_aux_weight * load-balance +
+                # router_z_weight * z-loss (models/moe.py).
+                loss = loss + moe_aux / cfg.num_layers
         return logits, loss
 
 
@@ -628,6 +649,7 @@ def generate_kv(
     max_new_tokens: int = 100,
     temperature: float = 1.0,
     top_k: int = 50,
+    prompt_lens: Optional[jax.Array] = None,
 ) -> jax.Array:
     """KV-cached autoregressive sampling: one prefill pass over the prompt,
     then one single-token forward per generated token.
@@ -638,15 +660,15 @@ def generate_kv(
     §3.5). Requires ``prompt_len + max_new_tokens <= config.max_seq_len``
     (the cache size); ``generate`` handles the windowed overflow case.
 
-    Prompts in a batch must all be real (uniform) length: the cache keeps
-    one running position shared across the batch and the decode attention
-    has no padding mask, so a ragged batch padded to a common width would
-    silently attend to the pad tokens. Batch rows of different lengths
-    belong in separate calls (or use ``generate``/``generate_bucketed``,
-    whose causal window never sees positions past each row's write
-    frontier... the same frontier for all rows — i.e. uniform-length there
-    too; true per-row raggedness needs per-row masks that neither path
-    implements, matching the reference's batch-of-one generator).
+    Ragged batches: pass ``prompt_lens`` ([b] int32, true lengths of
+    right-padded rows). Rows are re-packed LEFT-padded internally so every
+    row shares one cache frontier; per-row pad offsets ride the cache
+    collection and shift both the RoPE positions and the attention window,
+    so padding is never attended and each row's positions start at its own
+    first real token. Output rows come back right-padded (row r holds
+    ``prompt_lens[r] + max_new_tokens`` real tokens, zero-filled beyond) —
+    a mixed-length batch decodes in ONE call, where the reference's
+    generator is batch-of-one (``infer.py:60-66``).
     """
     model = GPT(config)
     b, prompt_len = input_ids.shape
@@ -660,6 +682,39 @@ def generate_kv(
     if max_new_tokens == 0:
         return input_ids
     cache = init_cache(config, b)
+
+    pad = None
+    if prompt_lens is not None:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+        if not isinstance(prompt_lens, jax.core.Tracer):
+            # Concrete lengths (the usual non-jit call): fail loudly on
+            # impossible values — a length beyond the padded width would
+            # silently repack garbage (negative left-pad duplicates tokens
+            # and the attention window degenerates).
+            vals = np.asarray(prompt_lens)
+            if vals.shape != (b,) or (vals <= 0).any() or (
+                vals > prompt_len
+            ).any():
+                raise ValueError(
+                    f"prompt_lens must be [batch]={b} values in "
+                    f"[1, {prompt_len}] (the padded width); got {vals}"
+                )
+        pad = (prompt_len - prompt_lens).astype(jnp.int32)     # [b]
+        # Right-padded -> left-padded rows (shared decode frontier).
+        cols = jax.lax.broadcasted_iota(jnp.int32, (b, prompt_len), 1)
+        src = jnp.clip(cols - pad[:, None], 0, prompt_len - 1)
+        input_ids = jnp.where(
+            cols >= pad[:, None],
+            jnp.take_along_axis(input_ids, src, axis=1),
+            jnp.zeros((), input_ids.dtype),
+        )
+        # Per-row pad offsets enter every layer's decode attention through
+        # its cache variable (models/gpt.py _decode_attention).
+        cache = jax.tree_util.tree_map_with_path(
+            lambda p, x: jnp.broadcast_to(pad, x.shape)
+            if getattr(p[-1], "key", None) == "pad" else x,
+            cache,
+        )
 
     # Prefill: one pass over the whole prompt populates every layer's cache.
     (logits, _), vars_out = model.apply(
@@ -693,6 +748,15 @@ def generate_kv(
     buf, _, _ = jax.lax.fori_loop(
         prompt_len + 1, total, body, (buf, cache, rng)
     )
+    if pad is not None:
+        # Left-padded -> right-padded output rows.
+        cols = jax.lax.broadcasted_iota(jnp.int32, (b, total), 1)
+        src = jnp.clip(cols + pad[:, None], 0, total - 1)
+        real = cols < (total - pad)[:, None]
+        buf = jnp.where(
+            real, jnp.take_along_axis(buf, src, axis=1),
+            jnp.zeros((), buf.dtype),
+        )
     return buf
 
 

@@ -12,16 +12,21 @@ parallelism: sharding the expert leaves over the ``expert`` mesh axis makes
 XLA emit the all-to-all between data-sharded tokens and expert-sharded FFNs
 automatically. No collective appears in this file.
 
-Mechanics (Switch Transformer, arXiv:2101.03961):
+Mechanics (Switch Transformer, arXiv:2101.03961; top-2 per GShard/ST-MoE):
 
-- router: ``logits [T, E]`` in f32; top-1 expert per token.
-- capacity ``C = ceil(T/E * capacity_factor)``; per-expert positions come
-  from a cumsum over the one-hot assignment; tokens beyond capacity are
-  dropped (contribute zero, like the paper).
-- combine weight = router probability of the chosen expert.
-- aux load-balance loss ``E * sum_e f_e * p_e`` (fraction of tokens routed
-  to e times mean router prob of e), returned for the model to add with
-  ``moe_aux_weight``.
+- router: ``logits [T, E]`` in f32; top-k experts per token
+  (``moe_top_k``: 1 = Switch, gate = router prob; 2 = GShard, gates
+  renormalized over the chosen pair).
+- capacity ``C = ceil(k*T/E * capacity_factor)``; per-expert positions
+  come from a cumsum over the one-hot assignments in *choice-major* order
+  (every token's first choice queues before any second choice — at
+  capacity, second choices drop first); tokens beyond capacity are
+  dropped (contribute zero, like the papers).
+- aux losses, returned PRE-WEIGHTED as one scalar the model adds
+  directly: ``moe_aux_weight * (E * sum_e f_e * p_e)`` (load balance,
+  over first-choice assignment fractions) plus ``router_z_weight *
+  mean(logsumexp(logits)^2)`` (ST-MoE z-loss, arXiv:2202.08906 — keeps
+  router logits from drifting into softmax saturation).
 """
 
 from __future__ import annotations
@@ -37,7 +42,7 @@ from tpu_trainer.models.config import GPTConfig
 
 
 class MoEMLP(nn.Module):
-    """Top-1 routed expert SwiGLU (replaces ``MLP`` when experts are on)."""
+    """Top-k routed expert SwiGLU (replaces ``MLP`` when experts are on)."""
 
     config: GPTConfig
 
@@ -47,6 +52,7 @@ class MoEMLP(nn.Module):
     ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.config
         E = cfg.num_experts
+        k = cfg.moe_top_k
         b, s, H = x.shape
         T = b * s
         I = cfg.intermediate_size
@@ -56,7 +62,7 @@ class MoEMLP(nn.Module):
             # token colliding on an expert). Give every token a slot.
             C = T
         else:
-            C = max(1, math.ceil(T / E * cfg.expert_capacity_factor))
+            C = max(1, math.ceil(k * T / E * cfg.expert_capacity_factor))
 
         xt = x.reshape(T, H)
 
@@ -67,24 +73,37 @@ class MoEMLP(nn.Module):
             name="router",
         )(xt.astype(jnp.float32))
         probs = jax.nn.softmax(router_logits, axis=-1)          # [T, E]
-        expert_idx = jnp.argmax(probs, axis=-1)                 # [T]
-        assign = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)           # [T, k]
+        assign_k = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [T,k,E]
 
-        # Aux load-balance loss uses pre-capacity assignment fractions.
-        frac = jnp.mean(assign, axis=0)                         # [E]
-        mean_prob = jnp.mean(probs, axis=0)                     # [E]
-        aux = E * jnp.sum(frac * mean_prob)
-
-        # Position of each token within its expert's queue; drop past C.
-        pos = jnp.cumsum(assign, axis=0) - assign               # [T, E]
-        keep = (pos < C).astype(jnp.float32) * assign
-        gate = jnp.sum(probs * keep, axis=-1)                   # [T]
-        pos_idx = jnp.sum(pos * assign, axis=-1).astype(jnp.int32)
-
-        # dispatch [T, E, C]: 1 at (t, expert(t), pos(t)) for kept tokens.
-        dispatch = (
-            keep[:, :, None] * jax.nn.one_hot(pos_idx, C, dtype=jnp.float32)[:, None, :]
+        # Gates: Switch keeps the raw router prob at k=1; at k>1 the chosen
+        # probs renormalize to sum 1 (GShard/Mixtral semantics).
+        gates = gate_vals if k == 1 else (
+            gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
         )
+
+        # Aux load-balance loss uses pre-capacity FIRST-choice fractions.
+        frac = jnp.mean(assign_k[:, 0], axis=0)                 # [E]
+        mean_prob = jnp.mean(probs, axis=0)                     # [E]
+        aux = cfg.moe_aux_weight * E * jnp.sum(frac * mean_prob)
+        if cfg.router_z_weight > 0.0:
+            z = jax.nn.logsumexp(router_logits, axis=-1)        # [T]
+            aux = aux + cfg.router_z_weight * jnp.mean(z * z)
+
+        # Position of each token-choice in its expert's queue, counted in
+        # choice-major order (all first choices precede any second choice,
+        # so capacity overflow drops second choices first); drop past C.
+        assign_flat = assign_k.transpose(1, 0, 2).reshape(k * T, E)
+        pos_flat = jnp.cumsum(assign_flat, axis=0) - assign_flat
+        pos_k = pos_flat.reshape(k, T, E).transpose(1, 0, 2)    # [T, k, E]
+        keep_k = (pos_k < C).astype(jnp.float32) * assign_k
+        pos_idx = jnp.sum(pos_k * assign_k, axis=-1).astype(jnp.int32)
+
+        # slot [T, k, E, C]: 1 at (t, c, expert(t,c), pos(t,c)) for kept
+        # token-choices; dispatch sums choices, combine weighs them by gate.
+        slot = (keep_k[..., None]
+                * jax.nn.one_hot(pos_idx, C, dtype=jnp.float32)[:, :, None, :])
+        dispatch = jnp.sum(slot, axis=1)                        # [T, E, C]
 
         dtype = cfg.compute_dtype
         expert_in = jnp.einsum(
@@ -106,7 +125,9 @@ class MoEMLP(nn.Module):
         hmid = act(hmid) * jnp.einsum("ech,ehi->eci", expert_in, w_up)
         expert_out = jnp.einsum("eci,eih->ech", hmid, w_down)   # [E, C, H]
 
-        combine = dispatch * gate[:, None, None]                # [T, E, C]
+        combine = jnp.sum(
+            slot * gates[:, :, None, None], axis=1
+        )                                                       # [T, E, C]
         out = jnp.einsum(
             "tec,ech->th", combine.astype(dtype), expert_out
         ).reshape(b, s, H)

@@ -20,6 +20,16 @@ Mechanics:
 - Causality by *global* position: chunk offsets ``i*sl`` (queries) and
   ``src*sl`` (keys). Fully-future chunks contribute zero through the mask —
   every device runs the same step count (uniform SPMD control flow).
+- **Zigzag (balanced-causal) layout, on by default** for even local
+  lengths: each device is re-assigned the stripe pair ``(i, 2sp-1-i)``
+  (two half-stripe ppermutes in, one pair out), after which every ring
+  step carries exactly half a stripe-square of real work on *every*
+  device — the contiguous layout computes the full score square because
+  the synchronous ring makes everyone pay the worst device's bill
+  (device 0 erases sp-1 of its sp chunks; device sp-1 needs them all).
+  FLOP accounting per device: contiguous ring = sp chunk-squares; zigzag
+  = 1 causal local block + (sp-1) half-blocks ≈ (sp+1)/2 — a 2x saving
+  at large sp, load-balanced exactly.
 - Differentiable by construction (pure jnp + ppermute, which has a
   well-defined transpose), so the backward pass needs no custom VJP.
 
@@ -70,10 +80,12 @@ def current_context() -> Optional[SequenceParallelContext]:
     return _ACTIVE
 
 
-def _kernel_mode(sl: int):
-    """``(use_kernel, interpret)`` for a chunk length: the kernel runs when
-    the chunk tiles the Pallas blocks and either a TPU is present or
-    interpret mode is forced (the CPU test hook shared with the attention
+def _kernel_mode(sl: int, head_dim: int):
+    """``(use_kernel, interpret)`` for a chunk: the kernel runs when the
+    chunk tiles the Pallas blocks, the head dim has a compiled lowering
+    (64 or a multiple of 128 — flash.py's folded-layout constraint; any
+    head dim works interpreted), and either a TPU is present or interpret
+    mode is forced (the CPU test hook shared with the attention
     dispatch)."""
     import os
 
@@ -81,6 +93,8 @@ def _kernel_mode(sl: int):
 
     interpret = os.environ.get(_INTERPRET_ENV, "0") == "1"
     if sl % 128 != 0:
+        return False, interpret
+    if not interpret and not (head_dim == 64 or head_dim % 128 == 0):
         return False, interpret
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
     return (on_tpu or interpret), interpret
@@ -188,6 +202,152 @@ def _ring_attention_local(q, k, v, rng, *, axis_name: str, sp: int,
     return (acc / norm).astype(q.dtype)
 
 
+def _to_zigzag(x, idx, axis_name: str, sp: int):
+    """Contiguous chunk -> zigzag stripe pair, inside the ring's shard_map.
+
+    Global layout in half-stripes of ``sl/2``: device ``i`` holds stripes
+    ``(2i, 2i+1)`` contiguously; zigzag wants ``(i, 2sp-1-i)`` — the classic
+    balanced-causal assignment where every device owns one "early" and one
+    "late" stripe. Each pair ``(i, 2sp-1-i)`` has exactly one even and one
+    odd member (their sum is odd), so two half-stripe ppermutes — one
+    routing all even stripes, one all odd — deliver both, and a select on
+    the device parity orders them (early first). Positions stay ascending
+    across the concat, which is what lets the t=0 local block run a plain
+    causal kernel.
+    """
+    half = x.shape[1] // 2
+    lo_h, hi_h = x[:, :half], x[:, half:]
+    owner = lambda j: j if j < sp else 2 * sp - 1 - j  # zigzag owner of stripe j
+    perm_even = [(i, owner(2 * i)) for i in range(sp)]
+    perm_odd = [(i, owner(2 * i + 1)) for i in range(sp)]
+    a = lax.ppermute(lo_h, axis_name, perm=perm_even)   # the pair's even stripe
+    c = lax.ppermute(hi_h, axis_name, perm=perm_odd)    # the pair's odd stripe
+    even = (idx % 2) == 0
+    lo = jnp.where(even, a, c)
+    hi = jnp.where(even, c, a)
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def _from_zigzag(x, idx, axis_name: str, sp: int):
+    """Inverse of ``_to_zigzag`` (applied to the attention output)."""
+    half = x.shape[1] // 2
+    lo, hi = x[:, :half], x[:, half:]        # stripes (idx, 2sp-1-idx)
+    even = (idx % 2) == 0
+    a = jnp.where(even, lo, hi)              # the even stripe of the pair
+    c = jnp.where(even, hi, lo)              # the odd stripe
+    owner = lambda j: j if j < sp else 2 * sp - 1 - j
+    perm_even = [(owner(2 * i), i) for i in range(sp)]
+    perm_odd = [(owner(2 * i + 1), i) for i in range(sp)]
+    lo_h = lax.ppermute(a, axis_name, perm=perm_even)
+    hi_h = lax.ppermute(c, axis_name, perm=perm_odd)
+    return jnp.concatenate([lo_h, hi_h], axis=1)
+
+
+def _zigzag_ring_local(q, k, v, rng, *, axis_name: str, sp: int,
+                       scale: float, dropout_rate: float,
+                       use_kernel: bool, interpret: bool):
+    """Balanced (zigzag) ring body: every device does the same causal work.
+
+    With contiguous chunks, device 0's queries precede every rotated K/V
+    chunk, so it erases ``sp-1`` of its ``sp`` computations while device
+    ``sp-1`` needs all of them — and since ring steps synchronize on
+    ppermute, everyone pays the worst case: the ring computes the full
+    score square (2x the causal FLOPs). In stripe space, at ring step
+    ``t >= 1`` a device holding query stripes ``(i, 2sp-1-i)`` and K/V
+    stripes ``(src, 2sp-1-src)`` needs exactly TWO of the four stripe
+    pairs:
+
+    - ``q_hi x k_lo`` — always (the late query stripe follows every early
+      key stripe);
+    - ``q_lo x k_lo`` if ``src < i``, else ``q_hi x k_hi`` — same shape
+      either way, so the branch is two input *selects* feeding one kernel
+      call: uniform SPMD control flow, no lax.cond.
+
+    That is half the naive ring's compute, identical on every device. The
+    t=0 local block is ascending-position (early stripe first), so it runs
+    the plain causal kernel.
+    """
+    b, sl, h, d = q.shape
+    half = sl // 2
+    idx = lax.axis_index(axis_name)
+    qz = _to_zigzag(q, idx, axis_name, sp)
+    kz = _to_zigzag(k, idx, axis_name, sp)
+    vz = _to_zigzag(v, idx, axis_name, sp)
+
+    def chunk(qq, kk, vv, causal, rng_t):
+        if use_kernel:
+            from tpu_trainer.ops import flash
+
+            return flash.flash_attention(
+                qq, kk, vv, causal=causal, dropout_rate=dropout_rate,
+                dropout_rng=rng_t, interpret=interpret, return_lse=True,
+            )
+        return _chunk_attention_jnp(
+            qq, kk, vv, causal, scale, dropout_rate, rng_t
+        )
+
+    def fold(tag):
+        if dropout_rate > 0.0:
+            return jax.random.fold_in(rng, tag)
+        return None
+
+    def combine(carry, o_t, lse_t):
+        m, den, acc = carry
+        m_new = jnp.maximum(m, lse_t)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.exp(lse_t - m_new)
+        to_bshd = lambda x: x.transpose(0, 2, 1)[..., None]
+        acc = acc * to_bshd(alpha) + o_t.astype(jnp.float32) * to_bshd(w)
+        den = den * alpha + w
+        return m_new, den, acc
+
+    neg = jnp.full((b, h, half), _NEG_INF, jnp.float32)
+    zero = jnp.zeros((b, half, h, d), jnp.float32)
+
+    # t = 0: the ordered local stripe pair — one causal block.
+    o0, lse0 = chunk(qz, kz, vz, True, fold(idx))
+    carry = (lse0, jnp.ones((b, h, sl), jnp.float32), o0.astype(jnp.float32))
+
+    def step(t, state):
+        carry, k_t, v_t = state
+        k_t, v_t = lax.ppermute((k_t, v_t), axis_name, perm=[
+            (i, (i + 1) % sp) for i in range(sp)
+        ])
+        src = (idx - t) % sp
+        # call 1: late queries x early keys — needed at every step.
+        o1, lse1 = chunk(qz[:, half:], k_t[:, :half], v_t[:, :half], False,
+                         fold((t * 2 + 1) * sp + idx))
+        # call 2: early-x-early when the arriving pair is older, else
+        # late-x-late — selected by input, one kernel call either way.
+        low_case = src < idx
+        q2 = jnp.where(low_case, qz[:, :half], qz[:, half:])
+        k2 = jnp.where(low_case, k_t[:, :half], k_t[:, half:])
+        v2 = jnp.where(low_case, v_t[:, :half], v_t[:, half:])
+        o2, lse2 = chunk(q2, k2, v2, False, fold((t * 2 + 2) * sp + idx))
+        # Assemble full-row contributions and recombine by logsumexp.
+        carry_new = combine(
+            carry,
+            jnp.concatenate([zero, o1.astype(jnp.float32)], axis=1),
+            jnp.concatenate([neg, lse1], axis=2),
+        )
+        o2f = o2.astype(jnp.float32)
+        carry_new = combine(
+            carry_new,
+            jnp.concatenate([jnp.where(low_case, o2f, 0.0),
+                             jnp.where(low_case, 0.0, o2f)], axis=1),
+            jnp.concatenate([jnp.where(low_case, lse2, _NEG_INF),
+                             jnp.where(low_case, _NEG_INF, lse2)], axis=2),
+        )
+        return carry_new, k_t, v_t
+
+    if sp > 1:
+        carry, _, _ = lax.fori_loop(1, sp, step, (carry, kz, vz))
+    m, den, acc = carry
+    norm = den.transpose(0, 2, 1)[..., None]
+    out = (acc / norm).astype(q.dtype)
+    return _from_zigzag(out, idx, axis_name, sp)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -197,6 +357,7 @@ def ring_attention(
     *,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """Causal ring attention; global BSHD in/out, seq sharded over ``axis_name``.
 
@@ -229,11 +390,27 @@ def ring_attention(
     import functools
 
     sl = s // sp
-    use_kernel, interpret = _kernel_mode(sl)
-    body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, sp=sp, scale=scale,
-        dropout_rate=dropout_rate, use_kernel=use_kernel, interpret=interpret,
-    )
+    if zigzag is None:
+        # Balanced-causal stripes need an even local length; with one
+        # device there is nothing to balance.
+        zigzag = sp > 1 and sl % 2 == 0
+    elif zigzag and sl % 2 != 0:
+        raise ValueError(f"zigzag ring needs an even local length, got {sl}")
+    if zigzag and sp > 1:
+        # Kernel calls run at both sl (t=0) and sl/2 (ring steps).
+        use_kernel, interpret = _kernel_mode(sl // 2, d)
+        body = functools.partial(
+            _zigzag_ring_local, axis_name=axis_name, sp=sp, scale=scale,
+            dropout_rate=dropout_rate, use_kernel=use_kernel,
+            interpret=interpret,
+        )
+    else:
+        use_kernel, interpret = _kernel_mode(sl, d)
+        body = functools.partial(
+            _ring_attention_local, axis_name=axis_name, sp=sp, scale=scale,
+            dropout_rate=dropout_rate, use_kernel=use_kernel,
+            interpret=interpret,
+        )
     if dropout_rng is None:
         dropout_rng = jax.random.PRNGKey(0)  # unused when rate == 0
 

@@ -41,11 +41,17 @@ def apply_rotary_pos_emb(
 ) -> Tuple[jax.Array, jax.Array]:
     """Rotate q/k by position (reference ``gpt.py:120-147``).
 
-    q, k: ``[batch, seq, heads, head_dim]``; cos, sin: ``[seq, head_dim]``.
-    Applied in float32, cast back to the inputs' dtype.
+    q, k: ``[batch, seq, heads, head_dim]``; cos, sin: ``[seq, head_dim]``,
+    or ``[batch, seq, head_dim]`` for per-row positions (ragged decode:
+    left-padded rows start their RoPE positions at their own first real
+    token). Applied in float32, cast back to the inputs' dtype.
     """
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if cos.ndim == 3:
+        cos = cos[:, :, None, :]
+        sin = sin[:, :, None, :]
+    else:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
     q_rot = q32 * cos + rotate_half(q32) * sin
     k_rot = k32 * cos + rotate_half(k32) * sin

@@ -163,6 +163,65 @@ def dp_size(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
 
 
+def host_feed_info(sharding, global_shape, row_dim: int,
+                   process_of_device=None, process_index=None):
+    """Which batch-row slice this host must load: ``(feed_rank, feed_world)``.
+
+    Derived from the sharding's device->index map, so it is correct for any
+    mesh topology: hosts whose devices address the same global row range
+    form one feed group and must load IDENTICAL rows (e.g. a sequence or
+    tensor axis spanning hosts — the long-context pod layout); hosts with
+    disjoint ranges get consecutive ranks ordered by row start. For the
+    common dp%hosts==0 layout this degenerates to
+    ``(process_index, process_count)``.
+
+    ``process_of_device`` / ``process_index`` are injectable for tests
+    (simulating a multi-host device->process assignment on one real
+    process).
+
+    Raises if the distinct host row-coverages do not form an ordered
+    equal-size partition of the rows — then no consistent loader sharding
+    exists for this mesh layout.
+    """
+    pod = process_of_device or (lambda d: d.process_index)
+    pidx = jax.process_index() if process_index is None else process_index
+    rows_total = global_shape[row_dim]
+    cover = {}
+    for dev, idx in sharding.devices_indices_map(tuple(global_shape)).items():
+        sl = idx[row_dim]
+        start = 0 if sl.start is None else sl.start
+        stop = rows_total if sl.stop is None else sl.stop
+        cover.setdefault(pod(dev), set()).add((int(start), int(stop)))
+
+    def span(ranges):
+        # Each host's covered rows must be one contiguous run.
+        rs = sorted(ranges)
+        lo, hi = rs[0][0], rs[0][1]
+        for a, b in rs[1:]:
+            if a > hi:
+                raise ValueError(
+                    f"host row coverage {rs} is not contiguous — this mesh "
+                    f"device layout interleaves data shards within a host; "
+                    f"no consistent data feeding order exists"
+                )
+            hi = max(hi, b)
+        return (lo, hi)
+
+    spans = {p: span(rngs) for p, rngs in cover.items()}
+    groups = sorted(set(spans.values()))
+    size = groups[0][1] - groups[0][0]
+    for g, (lo, hi) in enumerate(groups):
+        if lo != g * size or hi - lo != size:
+            raise ValueError(
+                f"host row spans {groups} do not partition {rows_total} rows "
+                f"into equal ordered slices; no consistent data feeding "
+                f"order exists for this mesh layout"
+            )
+    if pidx not in spans:
+        raise ValueError(f"process {pidx} holds no addressable batch rows")
+    return groups.index(spans[pidx]), len(groups)
+
+
 def attention_shard_spec(mesh: Mesh, batch: int, heads: int,
                          kv_heads: Optional[int] = None):
     """PartitionSpec components for ``[b, s, h, d]`` attention operands.

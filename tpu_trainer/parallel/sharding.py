@@ -78,6 +78,12 @@ _TENSOR_RULES: List[Tuple[Tuple[str, ...], int]] = [
     (("mlp", "up_proj", "kernel"), -1),
     (("mlp", "down_proj", "kernel"), -2),
     (("embed_tokens", "embedding"), -1),
+    # Expert FFN weights ([.., E, H, I] / [.., E, I, H]): the same
+    # column/row-parallel split as the dense MLP, per expert — composes
+    # with the expert-dim sharding (_expert_dim) into EP x TP.
+    (("experts_gate",), -1),
+    (("experts_up",), -1),
+    (("experts_down",), -2),
 ]
 
 

@@ -152,6 +152,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
         p.add_argument("--sharding", type=str, default=None,
                        choices=_SHARDING_CHOICES)
         p.add_argument("--cpu_offload", action="store_true", default=None)
+        p.add_argument("--offload_dtype", default=None,
+                       choices=["float32", "bfloat16"],
+                       help="host storage dtype for offloaded optimizer "
+                            "state; bfloat16 halves the host-link stream")
         p.add_argument("--no_activation_checkpointing", action="store_true",
                        default=None)
     return p
@@ -288,6 +292,7 @@ def resolve_configs(args, mode: str):
 
     # --- parallelism ---------------------------------------------------
     cpu_offload = False
+    offload_dtype = "float32"
     if mode == "fsdp":
         strategy = _pick(getattr(args, "sharding", None),
                          y_fsdp.get("sharding_strategy"), "FULL_SHARD")
@@ -295,6 +300,8 @@ def resolve_configs(args, mode: str):
             _pick(getattr(args, "cpu_offload", None),
                   y_fsdp.get("cpu_offload"), False)
         )
+        offload_dtype = _pick(getattr(args, "offload_dtype", None),
+                              y_fsdp.get("offload_dtype"), "float32")
         default_mesh = mesh_lib.MeshConfig(data=1, fsdp=-1)
     else:
         strategy = "replicated"
@@ -314,7 +321,8 @@ def resolve_configs(args, mode: str):
         stage=_pick(args.mesh_stage, 1),
     )
     parallel_config = ParallelConfig(
-        mesh=mesh_config, sharding_strategy=strategy, cpu_offload=cpu_offload
+        mesh=mesh_config, sharding_strategy=strategy,
+        cpu_offload=cpu_offload, offload_dtype=offload_dtype
     )
 
     data_opts = {
@@ -351,29 +359,33 @@ def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
     loader-batch semantics (``ddp_trainer.py:538``) applied per host.
     """
     c = trainer.training_config
+    # Feed ranks come from the mesh's row coverage (Trainer.data_feed_*):
+    # hosts sharing a data shard (sequence/tensor axes spanning hosts) get
+    # the same rank and load identical rows.
+    feed_rank, feed_world = trainer.data_feed_rank, trainer.data_feed_world
     rows = (c.gradient_accumulation_steps * c.batch_size * trainer.dp_size
-            ) // trainer.process_count
+            ) // feed_world
     name = data_opts["dataset"]
     if name == "dummy":
         from tpu_trainer.data.dummy import create_dummy_dataloader
 
         train = create_dummy_dataloader(
-            batch_size=rows * trainer.process_count,
+            batch_size=rows * feed_world,
             seq_len=c.max_seq_len,
             vocab_size=model_config.vocab_size,
             num_batches=data_opts["num_batches"],
             seed=c.seed + 1234,
-            process_index=trainer.process_index,
-            process_count=trainer.process_count,
+            process_index=feed_rank,
+            process_count=feed_world,
         )
         eval_loader = create_dummy_dataloader(
-            batch_size=rows * trainer.process_count,
+            batch_size=rows * feed_world,
             seq_len=c.max_seq_len,
             vocab_size=model_config.vocab_size,
             num_batches=data_opts["eval_batches"],
             seed=c.seed + 4321,   # disjoint synthetic eval corpus
-            process_index=trainer.process_index,
-            process_count=trainer.process_count,
+            process_index=feed_rank,
+            process_count=feed_world,
         )
         return train, eval_loader
     if name == "tinystories":
@@ -392,8 +404,8 @@ def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
         max_tokens=data_opts["max_tokens"],
         streaming=data_opts["streaming"],
         cache_max_tokens=data_opts["cache_max_tokens"],
-        process_index=trainer.process_index,
-        process_count=trainer.process_count,
+        process_index=feed_rank,
+        process_count=feed_world,
         seed=trainer.training_config.seed,
         num_workers=data_opts["num_workers"],
         prefetch=data_opts["prefetch"],
