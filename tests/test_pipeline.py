@@ -102,11 +102,10 @@ class TestTransformerBlockPipeline:
         np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
-class TestPipelineAsStrategy:
-    """Pipeline parallelism as a first-class Trainer strategy (VERDICT r1
-    weak #4): a `stage` mesh axis routes the layer stack through the GPipe
-    schedule inside the real train step — composed with the optimizer,
-    grad-accum, and remat — and must be loss-equivalent to DDP."""
+class _StrategyHarness:
+    """Shared tiny-model runner for the strategy test classes (a plain
+    mixin, NOT a Test class: subclassing a Test class would re-collect and
+    re-run every inherited test per subclass)."""
 
     MODEL = GPTConfig(
         vocab_size=128, hidden_size=32, num_layers=4, num_heads=4,
@@ -132,6 +131,12 @@ class TestPipelineAsStrategy:
         for _ in range(steps):
             state, m = tr.train_step(state, batch)
         return float(m["loss"])
+
+class TestPipelineAsStrategy(_StrategyHarness):
+    """Pipeline parallelism as a first-class Trainer strategy (VERDICT r1
+    weak #4): a `stage` mesh axis routes the layer stack through the GPipe
+    schedule inside the real train step — composed with the optimizer,
+    grad-accum, and remat — and must be loss-equivalent to DDP."""
 
     def test_pipeline_losses_match_ddp(self):
         from tpu_trainer.parallel.mesh import MeshConfig
@@ -254,7 +259,130 @@ class TestPipelineAsStrategy:
         with pytest.raises(ValueError, match="num_layers"):
             Trainer(dc.replace(self.MODEL, num_layers=3), tc,
                     ParallelConfig(MeshConfig(data=2, fsdp=1, stage=4)))
+        # SP x PP is supported as of round 3 (jointly-manual shard_map);
+        # constructing the combined-mesh trainer must simply work.
+        Trainer(self.MODEL, tc,
+                ParallelConfig(
+                    MeshConfig(data=1, fsdp=1, sequence=2, stage=4)))
+
+
+class TestPipelineWithSequenceParallel(_StrategyHarness):
+    """SP x PP (VERDICT r2 item 3): the jointly-manual {stage, sequence}
+    shard_map with the ring unrolled inside — loss-equivalent to DDP."""
+
+    def test_stage2_sequence2_matches_ddp(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        sp_pp = self._run(MeshConfig(data=2, fsdp=1, sequence=2, stage=2), 4)
+        assert ddp == pytest.approx(sp_pp, rel=1e-5)
+
+    def test_stage2_sequence2_zero3(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        sp_pp_z3 = self._run(
+            MeshConfig(data=1, fsdp=2, sequence=2, stage=2), 2,
+            strategy="zero3",
+        )
+        assert ddp == pytest.approx(sp_pp_z3, rel=1e-5)
+
+
+class Test1F1BSchedule(_StrategyHarness):
+    """The manually-scheduled interleaved backward (VERDICT r2 item 4):
+    loss-equivalent to GPipe and DDP, with the activation-memory cap that
+    is 1F1B's point (min(M, 2S-1) in-flight stage inputs vs GPipe's M)."""
+
+    def _model_1f1b(self, **kw):
+        import dataclasses as dc
+
+        return dc.replace(self.MODEL, pipeline_schedule="1f1b", **kw)
+
+    def test_1f1b_matches_gpipe_and_ddp(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        gpipe = self._run(MeshConfig(data=2, fsdp=1, stage=4), 4)
+        ofob = self._run(MeshConfig(data=2, fsdp=1, stage=4), 4,
+                         model=self._model_1f1b())
+        assert ddp == pytest.approx(gpipe, rel=1e-5)
+        assert ddp == pytest.approx(ofob, rel=1e-5)
+
+    def test_1f1b_many_microbatches(self):
+        import dataclasses as dc
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        ofob = self._run(
+            MeshConfig(data=4, fsdp=1, stage=2), 2,
+            model=self._model_1f1b(pipeline_microbatches=8),
+        )
+        assert ddp == pytest.approx(ofob, rel=1e-5)
+
+    def test_1f1b_with_zero3_and_remat(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        ofob = self._run(
+            MeshConfig(data=1, fsdp=4, stage=2), 4,
+            model=self._model_1f1b(gradient_checkpointing=True),
+            strategy="zero3",
+        )
+        assert ddp == pytest.approx(ofob, rel=1e-5)
+
+    def test_1f1b_fused_loss_off(self):
+        import dataclasses as dc
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1,
+                        model=dc.replace(self.MODEL, fused_loss=False))
+        ofob = self._run(
+            MeshConfig(data=2, fsdp=1, stage=4), 4,
+            model=self._model_1f1b(fused_loss=False),
+        )
+        assert ddp == pytest.approx(ofob, rel=1e-5)
+
+    def test_1f1b_dropout_trains(self):
+        # Different (valid) rng stream than GPipe: check self-consistent
+        # deterministic training that learns.
+        import dataclasses as dc
+
+        import numpy as np
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+        from tpu_trainer.training.config import TrainingConfig
+        from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+        model = self._model_1f1b(dropout=0.1, attention_dropout=0.1)
+        tc = TrainingConfig(batch_size=4, max_seq_len=32,
+                            gradient_accumulation_steps=1,
+                            mixed_precision="fp32", warmup_steps=2,
+                            max_steps=30, learning_rate=1e-2)
+        tr = Trainer(model, tc,
+                     ParallelConfig(MeshConfig(data=2, fsdp=1, stage=4),
+                                    "replicated"))
+        batch = np.tile(np.arange(32, dtype=np.int32), (8, 1))
+        state = tr.init_state(seed=0)
+        first = None
+        for _ in range(12):
+            state, m = tr.train_step(state, batch)
+            first = first if first is not None else float(m["loss"])
+        assert float(m["loss"]) < first
+
+    def test_1f1b_guards(self):
+        import dataclasses as dc
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+        from tpu_trainer.training.config import TrainingConfig
+        from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+        tc = TrainingConfig(batch_size=4, max_seq_len=32,
+                            mixed_precision="fp32")
         with pytest.raises(NotImplementedError, match="sequence"):
-            Trainer(self.MODEL, tc,
-                    ParallelConfig(
-                        MeshConfig(data=1, fsdp=1, sequence=2, stage=4)))
+            Trainer(self._model_1f1b(), tc,
+                    ParallelConfig(MeshConfig(data=2, fsdp=1, sequence=2,
+                                              stage=2)))
+        with pytest.raises(ValueError, match="pipeline_schedule"):
+            dc.replace(self.MODEL, pipeline_schedule="wavefront")

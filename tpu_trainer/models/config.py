@@ -117,6 +117,13 @@ class GPTConfig:
     # (parallel/pipeline.py); 0 = auto (one microbatch per stage). More
     # microbatches -> smaller pipeline bubble, smaller per-step matmuls.
     pipeline_microbatches: int = 0
+    # Pipeline schedule: "gpipe" (AD of the forward scan — all M
+    # microbatch activations live at the bubble point) or "1f1b"
+    # (manually scheduled interleaved backward — at most min(M, 2S-1)
+    # stage inputs in flight, M-independent; stage blocks rematerialize
+    # in the backward). 1f1b requires a dense model (no MoE) and no
+    # sequence axis.
+    pipeline_schedule: str = "gpipe"
     # Counter-based dropout masks (ops/dropout.py) instead of threefry
     # bernoulli: same Bernoulli semantics, ~5x cheaper mask generation
     # (threefry masks measured ~9% of the headline step). Applies to the
@@ -169,6 +176,11 @@ class GPTConfig:
             raise ValueError(
                 f"moe_top_k ({self.moe_top_k}) must be in "
                 f"[1, num_experts={self.num_experts}]"
+            )
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r}; "
+                f"choose gpipe or 1f1b"
             )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(

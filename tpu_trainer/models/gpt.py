@@ -113,8 +113,30 @@ class CausalSelfAttention(nn.Module):
             cos, sin = rope_tables(s, cfg.head_dim, cfg.rope_theta)
             needs_rng = cfg.attention_dropout > 0.0 and not deterministic
             dropout_rng = self.make_rng("dropout") if needs_rng else None
+            manual_ctx = ring.current_manual_context()
             sp_ctx = ring.current_context()
-            if sp_ctx is not None and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1:
+            if (manual_ctx is not None
+                    and manual_ctx.mesh.shape[manual_ctx.axis_name] > 1):
+                # Already inside a manual region bound to the sequence axis
+                # (the SP x PP jointly-manual pipeline): x is the LOCAL
+                # sequence shard here. RoPE at global positions (this
+                # device's chunk offset), then the ring body directly —
+                # no nested shard_map.
+                sp = manual_ctx.mesh.shape[manual_ctx.axis_name]
+                cos_g, sin_g = rope_tables(s * sp, cfg.head_dim,
+                                           cfg.rope_theta)
+                off = jax.lax.axis_index(manual_ctx.axis_name) * s
+                cos_l = jax.lax.dynamic_slice(
+                    cos_g, (off, 0), (s, cfg.head_dim))
+                sin_l = jax.lax.dynamic_slice(
+                    sin_g, (off, 0), (s, cfg.head_dim))
+                q, k = apply_rotary_pos_emb(q, k, cos_l, sin_l)
+                out = ring.ring_attention_manual(
+                    q, k, v, sp, manual_ctx.axis_name,
+                    dropout_rate=cfg.attention_dropout if needs_rng else 0.0,
+                    dropout_rng=dropout_rng,
+                )
+            elif sp_ctx is not None and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1:
                 # Sequence parallelism: K/V ring over the mesh's sequence
                 # axis, each chunk through the flash kernel where available
                 # (ops/ring.py). Attention dropout runs per chunk.
@@ -398,10 +420,24 @@ class GPT(nn.Module):
                 return run_block(p, (xm, jnp.zeros((), jnp.float32)), rng)
 
             rng = self.make_rng("dropout") if needs_rng else None
-            x, moe_aux = pipeline_forward(
-                self.variables["params"]["layers"], x, block_fn, ctx_mesh,
-                cfg.pipeline_microbatches or stage_n, rng=rng, with_aux=True,
-            )
+            # SP x PP: go jointly manual over {stage, sequence} so the
+            # ring's collectives bind to this one manual region (Shardy
+            # rejects a nested manual region with loop-carried ppermute).
+            import contextlib as _cl
+
+            sp_n = ctx_mesh.shape.get(ring.SEQ_AXIS, 1)
+            if sp_n > 1:
+                seq_cm = ring.sequence_parallel_manual(ctx_mesh)
+                manual_seq = ring.SEQ_AXIS
+            else:
+                seq_cm = _cl.nullcontext()
+                manual_seq = None
+            with seq_cm:
+                x, moe_aux = pipeline_forward(
+                    self.variables["params"]["layers"], x, block_fn,
+                    ctx_mesh, cfg.pipeline_microbatches or stage_n, rng=rng,
+                    with_aux=True, manual_seq_axis=manual_seq,
+                )
         elif manual_apply and cfg.scan_unroll:
             # Unrolled apply path: parameters keep the nn.scan layout
             # ([num_layers, ...] stacked leaves, created by the scan branch
@@ -775,3 +811,136 @@ if __name__ == "__main__":
     logits, loss = model.apply({"params": params}, input_ids, labels=input_ids)
     print(f"Logits shape: {logits.shape}")
     print(f"Loss: {float(loss):.4f}")
+
+
+def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
+    """Build a grad_fn with ``jax.value_and_grad``'s interface for the
+    1F1B pipeline schedule (``GPTConfig.pipeline_schedule == "1f1b"``).
+
+    The GPipe path differentiates the schedule scan by AD, which keeps all
+    M microbatch activations alive at the bubble point; 1F1B needs the
+    backward manually interleaved with the forward, so the loss and every
+    gradient come out of ONE scheduled scan (``parallel/pipeline.py
+    pipeline_1f1b``) and the usual ``value_and_grad`` around ``GPT.apply``
+    is bypassed. This function replicates the model's embedding, stage
+    block, and head-loss computations exactly (same modules, same
+    ``fused_loss`` / materialized CE selection), assembling the full
+    parameter-gradient pytree: stacked layer grads from the schedule, the
+    tied embedding's gradient as head + lookup contributions, and the
+    final norm's from the head VJP.
+
+    Dropout streams are folded per (global layer, microbatch) from the
+    step rng directly — self-consistent and decorrelated, but a different
+    (equally valid) stream than the GPipe path's ``make_rng`` derivation;
+    loss-equivalence against GPipe holds exactly with dropout off.
+
+    Returns ``grad_fn(params, micro_ids, rng, loss_scale) ->
+    ((loss * scale, loss), grads)``.
+    """
+    from tpu_trainer.parallel.pipeline import pipeline_1f1b
+
+    cfg = model.config
+    S = mesh.shape["stage"]
+    lps = cfg.num_layers // S
+    M = num_microbatches
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "pipeline_schedule='1f1b' does not support MoE yet (the aux "
+            "loss does not ride the manual backward); use gpipe"
+        )
+    needs_rng = cfg.dropout > 0.0 or cfg.attention_dropout > 0.0
+    block_mod = TransformerBlock(cfg, deterministic=False)
+    norm_mod = RMSNorm(dtype=cfg.compute_dtype)
+    policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.dots_saveable,
+    }
+
+    def grad_fn(params, ids, rng, loss_scale):
+        emb = params["embed_tokens"]["embedding"]
+        vocab, hidden = emb.shape
+
+        def stage_fwd(local_params, xm, micro_idx):
+            def one_layer(carry, scanned):
+                li, p = scanned
+                rngs = {}
+                if needs_rng:
+                    g_layer = jax.lax.axis_index("stage") * lps + li
+                    rngs = {"dropout": jax.random.fold_in(
+                        rng, g_layer * M + micro_idx)}
+                (xc, aux), _ = block_mod.apply(
+                    {"params": p}, carry, rngs=rngs)
+                return (xc, aux), None
+
+            run = one_layer
+            if cfg.gradient_checkpointing:
+                run = jax.checkpoint(run, prevent_cse=False,
+                                     policy=policies[cfg.remat_policy])
+            (y, _), _ = jax.lax.scan(
+                run, (xm, jnp.zeros((), jnp.float32)),
+                (jnp.arange(lps), local_params),
+            )
+            return y
+
+        def head_loss(y, e_param, norm_params, labels_mb):
+            xn = norm_mod.apply({"params": norm_params}, y)
+            if cfg.fused_loss:
+                return fused_shifted_cross_entropy(
+                    e_param, xn, labels_mb, chunk_size=cfg.loss_chunk_size
+                )
+            logits = (
+                xn @ e_param.astype(cfg.compute_dtype).T
+            ).astype(jnp.float32)
+            return jnp.mean(
+                optax_softmax_cross_entropy(logits[:, :-1, :],
+                                            labels_mb[:, 1:])
+            )
+
+        def head_vjp(y, labels_mb, micro_idx):
+            # Per-micro loss contributes loss_m / M to the mean; the
+            # cotangent additionally carries the fp16 loss scale.
+            def f(yy, e_, nw_):
+                return head_loss(yy, e_, nw_, labels_mb)
+
+            loss_m, pull = jax.vjp(f, y, emb, params["norm"])
+            dy, de_head, dnorm = pull(
+                jnp.asarray(loss_scale / M, jnp.float32))
+            # dy stays in the activation dtype (what AD would propagate);
+            # parameter-grad accumulators stay f32.
+            return (loss_m / M,
+                    dy,
+                    {"embedding": de_head.astype(jnp.float32),
+                     "norm": jax.tree_util.tree_map(
+                         lambda g: g.astype(jnp.float32), dnorm)})
+
+        def emb_accum(acc, dx, ids_mb):
+            # d(embedding lookup): scatter-add each token's cotangent row.
+            flat = ids_mb.reshape(-1)
+            return acc.at[flat].add(dx.reshape(-1, hidden))
+
+        head_zeros = {
+            "embedding": jnp.zeros((vocab, hidden), jnp.float32),
+            "norm": jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params["norm"]),
+        }
+        emb_zeros = jnp.zeros((vocab, hidden), jnp.float32)
+
+        x = jnp.take(
+            emb.astype(cfg.compute_dtype), ids, axis=0
+        )  # nn.Embed semantics: cast table, then gather
+        loss_mean, dlayers, dhead, de_lookup = pipeline_1f1b(
+            params["layers"], x, ids, ids, stage_fwd, head_vjp,
+            head_zeros, emb_accum, emb_zeros, mesh, M,
+        )
+        # The lookup's cotangent arrives unscaled by loss_scale/M? No — dx
+        # flowed from head_vjp's scaled seed through the stage backwards,
+        # so every gradient here already carries loss_scale / M per micro,
+        # summed over micros.
+        grads = {
+            "embed_tokens": {"embedding": dhead["embedding"] + de_lookup},
+            "layers": dlayers,
+            "norm": dhead["norm"],
+        }
+        return (loss_mean * loss_scale, loss_mean), grads
+
+    return grad_fn

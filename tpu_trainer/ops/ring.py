@@ -80,6 +80,68 @@ def current_context() -> Optional[SequenceParallelContext]:
     return _ACTIVE
 
 
+_MANUAL: Optional[SequenceParallelContext] = None
+
+
+@contextlib.contextmanager
+def sequence_parallel_manual(mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """Trace-time context for code already INSIDE a manual region bound to
+    the sequence axis (the pipeline's jointly-manual shard_map over
+    {stage, sequence}): the attention dispatch then runs the ring body
+    directly — axis_index/ppermute against the bound axis — instead of
+    opening a nested shard_map, which is exactly what tripped Shardy's
+    nested manual-region axis binding (the round-2 SP x PP blocker)."""
+    global _MANUAL
+    prev = _MANUAL
+    _MANUAL = SequenceParallelContext(mesh, axis_name)
+    try:
+        yield
+    finally:
+        _MANUAL = prev
+
+
+def current_manual_context() -> Optional[SequenceParallelContext]:
+    return _MANUAL
+
+
+def ring_attention_manual(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    sp: int,
+    axis_name: str = SEQ_AXIS,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Ring attention for callers already inside a manual region bound to
+    ``axis_name`` (see ``sequence_parallel_manual``). ``q/k/v`` are the
+    LOCAL ``[b, sl, h, d]`` shards (rope already applied at global
+    positions); same zigzag-by-default selection as ``ring_attention``.
+
+    The ring steps are unrolled statically here (``sp`` is a mesh
+    constant): a ``fori_loop``-carried ppermute inside a *partial*-manual
+    region is the construct Shardy cannot bind, while unrolled ppermutes
+    bind fine.
+    """
+    b, sl, h, d = q.shape
+    if dropout_rate > 0.0 and dropout_rng is None:
+        raise ValueError("dropout_rate > 0 requires dropout_rng")
+    scale = 1.0 / math.sqrt(d)
+    zigzag = sp > 1 and sl % 2 == 0
+    use_kernel, interpret = _kernel_mode(
+        (sl // 2) if zigzag else sl, d
+    )
+    body = _zigzag_ring_local if zigzag else _ring_attention_local
+    if dropout_rng is None:
+        dropout_rng = jax.random.PRNGKey(0)  # unused when rate == 0
+    return body(
+        q, k, v, dropout_rng, axis_name=axis_name, sp=sp, scale=scale,
+        dropout_rate=dropout_rate, use_kernel=use_kernel,
+        interpret=interpret, unroll=True,
+    )
+
+
 def _kernel_mode(sl: int, head_dim: int):
     """``(use_kernel, interpret)`` for a chunk: the kernel runs when the
     chunk tiles the Pallas blocks, the head dim has a compiled lowering
@@ -136,7 +198,8 @@ def _chunk_attention_jnp(q, k, v, causal, scale, dropout_rate, rng):
 
 def _ring_attention_local(q, k, v, rng, *, axis_name: str, sp: int,
                           scale: float, dropout_rate: float,
-                          use_kernel: bool, interpret: bool):
+                          use_kernel: bool, interpret: bool,
+                          unroll: bool = False):
     """Per-device body under shard_map. q, k, v: local ``[b, sl, h, d]``.
 
     Each arriving K/V chunk is attended with the *flash kernel* (the chunk
@@ -197,7 +260,16 @@ def _ring_attention_local(q, k, v, rng, *, axis_name: str, sp: int,
         return m_new, den, acc, k_t, v_t
 
     if sp > 1:
-        m, den, acc, _, _ = lax.fori_loop(1, sp, step, (m, den, acc, k, v))
+        carry = (m, den, acc, k, v)
+        if unroll:
+            # Static unroll: a fori_loop-carried ppermute inside a
+            # partial-manual region (the SP x PP joint shard_map) trips
+            # Shardy's axis binding; unrolled ppermutes bind fine.
+            for t in range(1, sp):
+                carry = step(t, carry)
+        else:
+            carry = lax.fori_loop(1, sp, step, carry)
+        m, den, acc, _, _ = carry
     norm = den.transpose(0, 2, 1)[..., None]              # [b, sl, h, 1]
     return (acc / norm).astype(q.dtype)
 
@@ -245,7 +317,8 @@ def _from_zigzag(x, idx, axis_name: str, sp: int):
 
 def _zigzag_ring_local(q, k, v, rng, *, axis_name: str, sp: int,
                        scale: float, dropout_rate: float,
-                       use_kernel: bool, interpret: bool):
+                       use_kernel: bool, interpret: bool,
+                       unroll: bool = False):
     """Balanced (zigzag) ring body: every device does the same causal work.
 
     With contiguous chunks, device 0's queries precede every rotated K/V
@@ -341,7 +414,15 @@ def _zigzag_ring_local(q, k, v, rng, *, axis_name: str, sp: int,
         return carry_new, k_t, v_t
 
     if sp > 1:
-        carry, _, _ = lax.fori_loop(1, sp, step, (carry, kz, vz))
+        state = (carry, kz, vz)
+        if unroll:
+            # See _ring_attention_local: static unroll for partial-manual
+            # regions (SP x PP).
+            for t in range(1, sp):
+                state = step(t, state)
+        else:
+            state = lax.fori_loop(1, sp, step, state)
+        carry, _, _ = state
     m, den, acc = carry
     norm = den.transpose(0, 2, 1)[..., None]
     out = (acc / norm).astype(q.dtype)
@@ -424,13 +505,8 @@ def ring_attention(
         return body(q, k, v, rng)
 
     # Full-manual over the mesh (axes the specs don't mention are
-    # replicated). A partial-manual variant (axis_names restricted like the
-    # flash wrapper's) would be needed to nest the ring inside the pipeline
-    # stage body, but the ring's loop-carried ppermute trips Shardy's nested
-    # manual-region axis binding on jax 0.9 regardless, and partial mode
-    # forces check_vma=True, which would require vma annotations on the
-    # Pallas out_shapes — so SP x PP stays guarded off in the Trainer and
-    # the ring keeps the simple full-manual form.
+    # replicated). Inside the pipeline's jointly-manual region, callers use
+    # ring_attention_manual instead — the SP x PP composition path.
     fn = shard_map(
         local,
         mesh=mesh,

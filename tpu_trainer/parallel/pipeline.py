@@ -56,6 +56,7 @@ def pipeline_forward(
     axis_name: str = STAGE_AXIS,
     rng: Optional[jax.Array] = None,
     with_aux: bool = False,
+    manual_seq_axis: Optional[str] = None,
 ) -> Any:
     """Run ``x`` through the full layer stack with a GPipe schedule.
 
@@ -75,6 +76,14 @@ def pipeline_forward(
         (bubble steps excluded), summed over layers and averaged over
         microbatches — the per-micro estimator matching grad-accum
         semantics. Returns ``(activations, aux)``.
+      manual_seq_axis: when sequence parallelism composes with the
+        pipeline, the shard_map goes jointly manual over
+        ``{stage, manual_seq_axis}`` and activations enter seq-sharded:
+        the attention's ring collectives then bind to the SAME manual
+        region instead of opening a nested one (the construct Shardy
+        rejects). The model routes its attention through
+        ``ring.ring_attention_manual`` under
+        ``ring.sequence_parallel_manual``.
 
     Returns activations after all L layers, ``[batch, seq, hidden]``
     (plus the aux scalar when ``with_aux``).
@@ -97,9 +106,11 @@ def pipeline_forward(
         # x_local: full batch [b, s, h], replicated over `stage` (its data
         # sharding, if any, is handled by the surrounding auto axes).
         stage = lax.axis_index(axis_name)
+        # Local shapes: under joint SP the sequence dim entered sharded.
+        s_l = x_local.shape[1]
         # Strided microbatching: row j*M + m -> microbatch m (see module
         # docstring for why not contiguous).
-        micro = x_local.reshape(mb, M, s, h).transpose(1, 0, 2, 3)
+        micro = x_local.reshape(mb, M, s_l, h).transpose(1, 0, 2, 3)
 
         def run_stage(xm, t):
             micro_idx = t - stage  # valid in [0, M) when the step is real
@@ -110,9 +121,17 @@ def pipeline_forward(
                 args = (p, xc)
                 if rng_arg:
                     g_layer = stage * layers_per_stage + li
-                    args = args + (jax.random.fold_in(
+                    key = jax.random.fold_in(
                         rng_arg[0], g_layer * M + jnp.clip(micro_idx, 0, M - 1)
-                    ),)
+                    )
+                    if manual_seq_axis is not None:
+                        # Each sequence shard sees only its local slice, and
+                        # hash_dropout keys masks by LOCAL positions — fold
+                        # the shard index so chunks don't repeat one mask.
+                        key = jax.random.fold_in(
+                            key, lax.axis_index(manual_seq_axis)
+                        )
+                    args = args + (key,)
                 out = block_fn(*args)
                 if with_aux:
                     out, layer_aux = out
@@ -130,9 +149,9 @@ def pipeline_forward(
             return out, jnp.where(real, aux, 0.0)
 
         perm = [(i, (i + 1) % S) for i in range(S)]
-        outputs0 = jnp.zeros((M, mb, s, h), x_local.dtype)
+        outputs0 = jnp.zeros((M, mb, s_l, h), x_local.dtype)
         # `moving` is each stage's current inbound activation slot.
-        moving0 = jnp.zeros((mb, s, h), x_local.dtype)
+        moving0 = jnp.zeros((mb, s_l, h), x_local.dtype)
 
         def step(carry, t):
             moving, outputs, aux_acc = carry
@@ -166,10 +185,16 @@ def pipeline_forward(
         mask = (stage == S - 1).astype(outputs.dtype)
         outputs = lax.psum(outputs * mask, axis_name)
         # Undo the strided microbatch grouping.
-        outputs = outputs.transpose(1, 0, 2, 3).reshape(b, s, h)
+        outputs = outputs.transpose(1, 0, 2, 3).reshape(b, s_l, h)
         if with_aux:
-            # Sum over stages = sum over all layers; mean over microbatches.
+            # Sum over stages = sum over all layers; mean over microbatches
+            # (and over sequence shards under joint SP — each shard's aux
+            # estimates from its local tokens, the same per-shard estimator
+            # grad accumulation uses per micro).
             aux = lax.psum(aux_acc, axis_name) / M
+            if manual_seq_axis is not None:
+                sq = mesh.shape[manual_seq_axis]
+                aux = lax.psum(aux, manual_seq_axis) / sq
             return outputs, aux
         return outputs
 
@@ -178,12 +203,205 @@ def pipeline_forward(
     )
     rng_args = () if rng is None else (rng,)
     rng_specs = () if rng is None else (P(),)
+    x_spec = (P(None, manual_seq_axis, None) if manual_seq_axis is not None
+              else P())
+    manual = ({axis_name} if manual_seq_axis is None
+              else {axis_name, manual_seq_axis})
     fn = shard_map(
         staged,
         mesh=mesh,
-        in_specs=(layer_specs, P()) + rng_specs,
-        out_specs=(P(), P()) if with_aux else P(),
-        axis_names={axis_name},
+        in_specs=(layer_specs, x_spec) + rng_specs,
+        out_specs=(x_spec, P()) if with_aux else x_spec,
+        axis_names=manual,
         check_vma=False,
     )
     return fn(stacked_params, x, *rng_args)
+
+
+def pipeline_1f1b(
+    stacked_params: Any,
+    x: jax.Array,
+    input_ids: jax.Array,
+    labels: jax.Array,
+    stage_fwd: Callable,
+    head_vjp: Callable,
+    head_grad_zeros: Any,
+    emb_accum: Callable,
+    emb_grad_zeros: Any,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = STAGE_AXIS,
+) -> Any:
+    """Interleaved forward/backward (1F1B-style) pipeline with MANUAL
+    backward scheduling — the loss and every gradient come out of ONE scan.
+
+    Why not AD of the GPipe scan: differentiating ``pipeline_forward``
+    keeps every scan step's carry (all ``M`` microbatch activations) alive
+    until the bubble point, so pipeline activation memory scales with M —
+    the thing 1F1B exists to fix. Here each microbatch's backward starts as
+    soon as its forward clears the last stage (which computes that micro's
+    loss VJP in the SAME tick), so a stage retains at most
+    ``min(M, 2(S-1)+1)`` saved stage-inputs — independent of M. Stage
+    blocks are recomputed inside ``jax.vjp`` from the saved inputs
+    (stage-granular rematerialization), the same total compute as GPipe
+    with per-block remat.
+
+    Schedule (per tick ``t`` of ``M + 2(S-1)``; every stage runs both
+    masked halves — SPMD):
+
+    - forward half: stage ``s`` runs micro ``i = t - s`` when valid, and
+      every stage evaluates the head loss + cotangent for that micro with
+      only the LAST stage's result kept (masked, NOT ``lax.cond``: the
+      head contains GSPMD collectives over the auto axes, and a
+      stage-predicated branch deadlocks them — see the in-body comment.
+      The head therefore runs S x (M + 2S - 2) times; acceptable while
+      stage counts are small relative to the model/head FLOP ratio).
+    - backward half: stage ``s`` runs the backward of micro
+      ``j = t - 2(S-1) + s`` when valid (at the last stage ``j == i``: the
+      1F1B "B right after F"); cotangents travel left by ppermute; layer
+      grads accumulate locally; stage 0 folds ``dx`` into the embedding
+      gradient via ``emb_accum`` (no [M, ...] cotangent buffer).
+
+    Args:
+      stacked_params: ``[L, ...]`` leaves, sharded over ``axis_name``.
+      x: embedded activations ``[batch, seq, hidden]``.
+      labels: ``[batch, seq]`` int labels (microbatched alongside x).
+      stage_fwd: ``(local_params, x_mb, micro_idx) -> y_mb`` — this stage's
+        layer block; must fold its dropout rngs from ``micro_idx`` exactly
+        like the GPipe path so the two schedules are grad-equivalent.
+      head_vjp: ``(y_mb, labels_mb, micro_idx) -> (loss, dy, dhead)`` —
+        per-micro loss (already scaled by 1/M and any loss scale), its
+        cotangent wrt y, and the head-parameter grads.
+      head_grad_zeros / emb_grad_zeros: zero pytrees for the accumulators.
+      emb_accum: ``(acc, dx_mb, ids_mb) -> acc`` — folds a micro's input
+        cotangent into the embedding gradient at that micro's token ids
+        (runs on stage 0 only).
+
+    Returns ``(loss_sum, dlayers_stacked, dhead, demb)`` — loss summed over
+    microbatches (caller already folded 1/M into head_vjp).
+    """
+    S = mesh.shape[axis_name]
+    b, s, h = x.shape
+    M = num_microbatches
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by M={M}")
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % S != 0:
+        raise ValueError(
+            f"num_layers {n_layers} not divisible by {S} pipeline stages"
+        )
+    mb = b // M
+    W = min(M, 2 * (S - 1) + 1)  # max in-flight stage inputs (M-independent)
+
+    def staged(local_params, x_local, ids_local, labels_local):
+        stage = lax.axis_index(axis_name)
+        is_last = stage == S - 1
+        is_first = stage == 0
+        s_l = x_local.shape[1]
+        # Strided microbatching, as pipeline_forward.
+        micro = x_local.reshape(mb, M, s_l, h).transpose(1, 0, 2, 3)
+        iid = ids_local.reshape(mb, M, s_l).transpose(1, 0, 2)
+        lab = labels_local.reshape(mb, M, s_l).transpose(1, 0, 2)
+
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+        dlayers0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), local_params
+        )
+        carry0 = (
+            jnp.zeros((mb, s_l, h), x_local.dtype),   # inbound fwd act
+            jnp.zeros((mb, s_l, h), x_local.dtype),   # inbound cotangent
+            jnp.zeros((W, mb, s_l, h), x_local.dtype),  # saved stage inputs
+            dlayers0,
+            head_grad_zeros,
+            emb_grad_zeros,
+            jnp.zeros((), jnp.float32),               # loss acc
+        )
+
+        def tick(carry, t):
+            f_mov, b_mov, saved, dlayers, dhead, demb, loss_acc = carry
+
+            # ---- forward half -------------------------------------------
+            i_f = t - stage
+            f_valid = jnp.logical_and(i_f >= 0, i_f < M)
+            i_fc = jnp.clip(i_f, 0, M - 1)
+            x_in = jnp.where(is_first, micro[i_fc], f_mov)
+            y = stage_fwd(local_params, x_in, i_fc)
+            # Ring-buffer the stage input (guarded: invalid ticks must not
+            # clobber a live slot).
+            slot = i_fc % W
+            prev = lax.dynamic_index_in_dim(saved, slot, keepdims=False)
+            saved = lax.dynamic_update_index_in_dim(
+                saved, jnp.where(f_valid, x_in, prev), slot, 0
+            )
+
+            # Head loss + cotangent for the micro this stage just
+            # forwarded; only the LAST stage's result is real. Computed
+            # unconditionally with a mask: the head math contains
+            # GSPMD-inserted collectives over the auto (data) axes, and a
+            # lax.cond whose predicate is the stage index would make only
+            # some devices enter them — a rendezvous deadlock (observed on
+            # the CPU mesh). Uniform SPMD control flow or nothing.
+            loss_i, dy_i, dhead_i = head_vjp(y, lab[i_fc], i_fc)
+            gate = jnp.where(jnp.logical_and(f_valid, is_last), 1.0, 0.0)
+            loss_acc = loss_acc + gate * loss_i
+            dhead = jax.tree_util.tree_map(
+                lambda a, g: a + gate * g, dhead, dhead_i
+            )
+
+            # ---- backward half ------------------------------------------
+            j_b = t - 2 * (S - 1) + stage
+            b_valid = jnp.logical_and(j_b >= 0, j_b < M)
+            j_bc = jnp.clip(j_b, 0, M - 1)
+            # At the last stage j == i: consume this tick's dy directly.
+            # Cotangents travel in the activation dtype — exactly what AD
+            # of the bf16 forward would propagate between stages.
+            dy = jnp.where(is_last, dy_i, b_mov).astype(x_local.dtype)
+            x_saved = lax.dynamic_index_in_dim(saved, j_bc % W,
+                                               keepdims=False)
+            _, pullback = jax.vjp(
+                lambda p, xx: stage_fwd(p, xx, j_bc), local_params, x_saved
+            )
+            dp_j, dx_j = pullback(dy)
+            bgate = jnp.where(b_valid, 1.0, 0.0)
+            dlayers = jax.tree_util.tree_map(
+                lambda a, g: a + bgate * g, dlayers, dp_j
+            )
+
+            # Same uniformity rule for the embedding-gradient fold: run it
+            # everywhere, zero the contribution off stage 0.
+            fgate = jnp.where(jnp.logical_and(b_valid, is_first), 1.0, 0.0)
+            demb = emb_accum(demb, dx_j.astype(jnp.float32) * fgate,
+                             iid[j_bc])
+
+            f_mov_next = lax.ppermute(y, axis_name, fwd_perm)
+            b_mov_next = lax.ppermute(
+                (dx_j * bgate).astype(x_local.dtype), axis_name, bwd_perm)
+            return (f_mov_next, b_mov_next, saved, dlayers, dhead, demb,
+                    loss_acc), None
+
+        (_, _, _, dlayers, dhead, demb, loss_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(M + 2 * (S - 1))
+        )
+        loss = lax.psum(loss_acc, axis_name)
+        dhead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name), dhead
+        )
+        demb = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name), demb
+        )
+        return loss, dlayers, dhead, demb
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
+    )
+    fn = shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P(), P()),
+        out_specs=(P(), layer_specs, P(), P()),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    return fn(stacked_params, x, input_ids, labels)

@@ -216,22 +216,24 @@ def resolve_configs(args, mode: str):
     preset = _pick(args.model_size, _preset_from_name(y_model.get("name")), "small")
     model_config = GPTConfig.preset(preset)
     overrides = {}
-    for yaml_key, field in [
-        ("vocab_size", "vocab_size"), ("hidden_size", "hidden_size"),
-        ("num_layers", "num_layers"), ("num_heads", "num_heads"),
-        ("intermediate_size", "intermediate_size"), ("max_seq_len", "max_seq_len"),
-        ("dropout", "dropout"), ("attention_dropout", "attention_dropout"),
-        ("use_flash_attention", "use_flash_attention"),
-        ("gradient_checkpointing", "gradient_checkpointing"),
-        ("num_experts", "num_experts"),
-        ("num_kv_heads", "num_kv_heads"),
-        ("expert_capacity_factor", "expert_capacity_factor"),
-        ("moe_aux_weight", "moe_aux_weight"),
-        ("remat_policy", "remat_policy"),
-        ("remat_lm_head", "remat_lm_head"),
-    ]:
-        if yaml_key in y_model:
-            overrides[field] = y_model[yaml_key]
+    # Any GPTConfig field may appear under `model:` (yaml keys == field
+    # names; the reference schema's keys are a subset). Unknown keys fail
+    # loudly — a silently-dropped `pipeline_schedule: 1f1b` once trained a
+    # different configuration than the yaml said.
+    _model_fields = {f.name for f in dataclasses.fields(GPTConfig)}
+    for yaml_key, val in y_model.items():
+        if yaml_key == "name":
+            continue  # preset selector, handled above
+        if yaml_key not in _model_fields:
+            raise SystemExit(
+                f"unknown model config key {yaml_key!r} in {args.config}; "
+                f"valid keys: name, {', '.join(sorted(_model_fields))}"
+            )
+        overrides[yaml_key] = val
+    if "hidden_size" in overrides and "intermediate_size" not in overrides:
+        # Re-derive 4*hidden in __post_init__ rather than inheriting the
+        # preset's intermediate size for a different hidden size.
+        overrides["intermediate_size"] = None
     if args.seq_len is not None:
         overrides["max_seq_len"] = args.seq_len
     if args.num_experts is not None:
@@ -280,6 +282,7 @@ def resolve_configs(args, mode: str):
                              defaults.save_interval),
         mixed_precision=_pick(args.mixed_precision,
                               y_dist.get("mixed_precision"),
+                              y_train.get("mixed_precision"),
                               defaults.mixed_precision),
         gradient_accumulation_steps=_picki(
             args.grad_accum, y_train.get("gradient_accumulation_steps"),

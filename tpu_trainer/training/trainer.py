@@ -170,21 +170,31 @@ class Trainer:
                     f"num_layers {self.model_config.num_layers} not divisible "
                     f"by stage axis size {self.stage_size}"
                 )
-            if self.sp_size > 1:
-                raise NotImplementedError(
-                    "pipeline parallelism does not compose with sequence "
-                    "parallelism yet: the ring's loop-carried ppermute "
-                    "inside the stage body trips Shardy's nested "
-                    "manual-region axis binding (reproduced on jax 0.9; "
-                    "plain nested shard_map and non-loop collectives nest "
-                    "fine)"
-                )
+            # SP x PP composes: the pipeline's shard_map goes jointly
+            # manual over {stage, sequence} and the ring runs unrolled
+            # inside it (models/gpt.py pipeline branch,
+            # ring.ring_attention_manual) — the round-2 guard against
+            # Shardy's nested manual-region binding is gone.
+            if self.model_config.pipeline_schedule == "1f1b":
+                if self.sp_size > 1:
+                    raise NotImplementedError(
+                        "pipeline_schedule='1f1b' does not compose with a "
+                        "sequence axis yet; use gpipe for SP x PP"
+                    )
+                if self.model_config.num_experts > 0:
+                    raise NotImplementedError(
+                        "pipeline_schedule='1f1b' does not support MoE "
+                        "yet; use gpipe"
+                    )
             microbatches = (self.model_config.pipeline_microbatches
                             or self.stage_size)
-            if training_config.batch_size % microbatches != 0:
+            global_rows = (training_config.batch_size
+                           * mesh_lib.dp_size(self.mesh))
+            if global_rows % microbatches != 0:
                 raise ValueError(
-                    f"batch_size {training_config.batch_size} (rows per data "
-                    f"shard) not divisible by pipeline_microbatches "
+                    f"global batch {global_rows} rows (batch_size "
+                    f"{training_config.batch_size} x {mesh_lib.dp_size(self.mesh)} "
+                    f"data shards) not divisible by pipeline_microbatches "
                     f"{microbatches}"
                 )
         self.model = GPT(self.model_config)
@@ -509,7 +519,28 @@ class Trainer:
                 )
             return loss * scale, loss
 
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        if (self.stage_size > 1
+                and self.model_config.pipeline_schedule == "1f1b"):
+            # Manual interleaved-backward schedule: the loss and gradients
+            # come from one scheduled scan instead of AD over the GPipe
+            # forward — the activation-memory cap 1F1B exists for
+            # (models/gpt.py pipeline_1f1b_value_and_grad).
+            from tpu_trainer.models.gpt import pipeline_1f1b_value_and_grad
+
+            _raw_1f1b = pipeline_1f1b_value_and_grad(
+                self.model, self.mesh,
+                self.model_config.pipeline_microbatches or self.stage_size,
+            )
+
+            def grad_fn(p, micro, rng_, scale_):
+                # Same trace context as loss_fn: publishes the mesh so the
+                # flash dispatch shard_maps its batch/head axes — without
+                # it the Pallas call inside the stage body would force
+                # batch replication, the memory cliff 1F1B exists to avoid.
+                with self._sp_context():
+                    return _raw_1f1b(p, micro, rng_, scale_)
+        else:
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
         if accum == 1:
             # No accumulation buffer — one backward, grads consumed in place.
