@@ -386,3 +386,35 @@ class Test1F1BSchedule(_StrategyHarness):
                                               stage=2)))
         with pytest.raises(ValueError, match="pipeline_schedule"):
             dc.replace(self.MODEL, pipeline_schedule="wavefront")
+
+
+class TestManualSeqDropoutDecorrelation:
+    def test_sequence_shards_fold_distinct_keys(self):
+        # Under the jointly-manual {stage, sequence} pipeline, block rngs
+        # fold in the sequence-shard index: a block that leaks its rng as
+        # data must show different bits on each shard (a missing fold once
+        # repeated one residual-dropout mask on every chunk).
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+        from tpu_trainer.parallel.pipeline import pipeline_forward
+
+        mesh = make_mesh(MeshConfig(data=1, fsdp=2, sequence=2, stage=2))
+        L, b, s, h = 2, 2, 8, 16
+        params = {"w": jnp.zeros((L, 1))}
+
+        def block_fn(p, x, rng):
+            bits = jax.random.uniform(rng, (1, 1, h))
+            return x * 0.0 + bits  # output = rng fingerprint
+
+        x = jnp.zeros((b, s, h))
+        out = jax.jit(lambda pp, xx: pipeline_forward(
+            pp, xx, block_fn, mesh, 1, rng=jax.random.PRNGKey(0),
+            manual_seq_axis="sequence",
+        ))(params, x)
+        out = np.asarray(out)
+        # Shard 0 owns positions [0, s/2), shard 1 the rest: fingerprints
+        # must differ across the shard boundary.
+        assert not np.allclose(out[:, 0], out[:, s // 2])

@@ -141,6 +141,11 @@ class GPTConfig:
     # the right choice for very deep models or fast iteration.
     scan_unroll: bool = True
 
+    # Static switch for the ragged (per-row prompt length) KV-decode path:
+    # set internally by generate_kv(prompt_lens=...); uniform decode keeps
+    # the cheaper shared-position attention. Not a training knob.
+    decode_ragged: bool = False
+
     # REPRODUCIBILITY NOTE: fused_loss, fast_dropout, and scan_unroll
     # default on as of v0.2, and the dropout-hash gained a second mix round
     # in v0.3. Each changes the dropout RNG stream and/or loss reduction

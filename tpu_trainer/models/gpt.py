@@ -195,20 +195,29 @@ class CausalSelfAttention(nn.Module):
         # Per-row left-pad sizes for ragged batches (generate_kv left-pads
         # mixed-length prompts to a shared frontier): row r's positions
         # < pad[r] are padding — excluded from attention windows and from
-        # RoPE position counting. All-zero (the default) is exactly the
-        # uniform-length behavior.
-        cp = self.variable(
-            "cache", "pad", lambda: jnp.zeros((b,), jnp.int32)
-        )
+        # RoPE position counting. The variable only exists (and the
+        # per-row machinery only traces) when the caller statically asked
+        # for ragged decode — uniform batches keep the cheaper shared-
+        # position path.
+        ragged = cfg.decode_ragged
+        if ragged:
+            cp = self.variable(
+                "cache", "pad", lambda: jnp.zeros((b,), jnp.int32)
+            )
+            pad = cp.value
         idx = ci.value
-        pad = cp.value
 
         cos, sin = rope_tables(max_len, d, cfg.rope_theta)
-        # Logical (post-pad) positions per row; clamped at 0 for the pad
-        # region itself (whose outputs are never read).
-        gpos = idx + jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
-        lpos = jnp.maximum(gpos - pad[:, None], 0)              # [b, s]
-        q, k = apply_rotary_pos_emb(q, k, cos[lpos], sin[lpos])
+        if ragged:
+            # Logical (post-pad) positions per row; clamped at 0 for the
+            # pad region itself (whose outputs are never read).
+            gpos = idx + jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+            lpos = jnp.maximum(gpos - pad[:, None], 0)          # [b, s]
+            q, k = apply_rotary_pos_emb(q, k, cos[lpos], sin[lpos])
+        else:
+            cos_s = jax.lax.dynamic_slice(cos, (idx, 0), (s, d))
+            sin_s = jax.lax.dynamic_slice(sin, (idx, 0), (s, d))
+            q, k = apply_rotary_pos_emb(q, k, cos_s, sin_s)
 
         k_all = jax.lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
         v_all = jax.lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
@@ -227,17 +236,24 @@ class CausalSelfAttention(nn.Module):
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_all) * scale
         q_pos = idx + jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, max_len), 1)
-        # Causal, excluding each row's left padding. Pad-region queries keep
-        # their self position so their (never-read) softmax rows stay
-        # finite — an empty window would put NaN into this position's
-        # residual stream and poison later layers' cached K/V.
-        allowed = (k_pos[None] <= q_pos[None]) & (
-            (k_pos[None] >= pad[:, None, None])
-            | (k_pos[None] == q_pos[None])
-        )
-        scores = jnp.where(
-            allowed[:, None], scores, jnp.finfo(scores.dtype).min
-        )
+        if ragged:
+            # Causal, excluding each row's left padding. Pad-region queries
+            # keep their self position so their (never-read) softmax rows
+            # stay finite — an empty window would put NaN into this
+            # position's residual stream and poison later layers' cached
+            # K/V.
+            allowed = (k_pos[None] <= q_pos[None]) & (
+                (k_pos[None] >= pad[:, None, None])
+                | (k_pos[None] == q_pos[None])
+            )
+            scores = jnp.where(
+                allowed[:, None], scores, jnp.finfo(scores.dtype).min
+            )
+        else:
+            allowed = k_pos <= q_pos
+            scores = jnp.where(
+                allowed[None, None], scores, jnp.finfo(scores.dtype).min
+            )
         weights = jax.nn.softmax(
             scores.astype(jnp.float32), axis=-1
         ).astype(q.dtype)
@@ -706,6 +722,12 @@ def generate_kv(
     a mixed-length batch decodes in ONE call, where the reference's
     generator is batch-of-one (``infer.py:60-66``).
     """
+    import dataclasses as _dc
+
+    if prompt_lens is not None:
+        # Static switch: the per-row pad machinery only traces when asked
+        # for (uniform decode keeps the cheaper shared-position path).
+        config = _dc.replace(config, decode_ragged=True)
     model = GPT(config)
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
