@@ -111,7 +111,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         batch_size=batch_size * accum * trainer.dp_size // trainer.process_count,
         seq_len=seq_len,
         vocab_size=model_config.vocab_size,
-        num_batches=3 * steps + 3,
+        num_batches=5 * steps + 3,
     )
     it = iter(loader)
 
@@ -123,7 +123,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         state, metrics = trainer.train_step(state, next(it))
     float(metrics["loss"])
 
-    # Three measured windows, keep the fastest: the shared/tunneled chip
+    # Five measured windows, keep the fastest: the shared/tunneled chip
     # shows minutes-long contention spikes where wall clock runs up to 3x
     # device-busy time (benchmarks/results.md, "axon" notes) — the minimum
     # window reflects the machine's actual capability, the same rationale
@@ -131,7 +131,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     # tunnel block_until_ready does not block; a host read does).
     elapsed = float("inf")
     final_loss = None
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(steps):
             batch = next(it)
