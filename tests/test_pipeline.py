@@ -418,3 +418,36 @@ class TestManualSeqDropoutDecorrelation:
         # Shard 0 owns positions [0, s/2), shard 1 the rest: fingerprints
         # must differ across the shard boundary.
         assert not np.allclose(out[:, 0], out[:, s // 2])
+
+
+class Test1F1BLongerEquivalence(_StrategyHarness):
+    def test_1f1b_curve_matches_gpipe_with_accum(self):
+        # 10 steps with grad accumulation: the losses must track GPipe's
+        # step for step (any backward error compounds over updates).
+        import dataclasses as dc
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+        from tpu_trainer.training.config import TrainingConfig
+        from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+        def curve(schedule):
+            model = dc.replace(self.MODEL, pipeline_schedule=schedule)
+            tc = TrainingConfig(
+                batch_size=4, max_seq_len=32, gradient_accumulation_steps=2,
+                mixed_precision="fp32", warmup_steps=2, max_steps=20,
+                learning_rate=1e-3,
+            )
+            tr = Trainer(model, tc,
+                         ParallelConfig(MeshConfig(data=2, fsdp=1, stage=4),
+                                        "replicated"))
+            state = tr.init_state(seed=0)
+            batch = np.random.default_rng(3).integers(0, 128, (16, 32),
+                                                      np.int32)
+            out = []
+            for _ in range(10):
+                state, m = tr.train_step(state, batch)
+                out.append(float(m["loss"]))
+            return out
+
+        gpipe, ofob = curve("gpipe"), curve("1f1b")
+        np.testing.assert_allclose(ofob, gpipe, rtol=2e-5)
