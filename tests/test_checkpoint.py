@@ -116,6 +116,21 @@ class TestCheckpoint:
         assert ckpt.latest_checkpoint(str(tmp_path)) == p2
         assert p1 != p2
 
+    def test_restore_incompatible_model_fails_loudly(self, tmp_path):
+        """A stale checkpoint dir + a different --model_size must name the
+        differing config fields, not die inside orbax with a bare
+        shape-mismatch (the auto-resume path hits this trivially)."""
+        trainer = make_trainer()
+        state = trainer.init_state()
+        path = ckpt.save_checkpoint(str(tmp_path), state, model_config=MODEL,
+                                    training_config=TRAIN)
+        bigger = dataclasses.replace(MODEL, hidden_size=64, num_heads=8)
+        mesh = make_mesh(MeshConfig(data=8, fsdp=1))
+        other = Trainer(bigger, TRAIN, ParallelConfig(MeshConfig(data=8, fsdp=1),
+                                                      "replicated"), mesh=mesh)
+        with pytest.raises(ValueError, match="hidden_size"):
+            ckpt.restore_checkpoint(path, other)
+
     def test_meta_reconstructs_configs(self, tmp_path):
         trainer = make_trainer()
         state = trainer.init_state()

@@ -143,7 +143,6 @@ class TestMoELayer:
         top1 = probs.argmax(-1)
         wg, wu, wd = (np.asarray(params[k]) for k in
                       ("experts_gate", "experts_up", "experts_down"))
-        import scipy.special  # noqa: F401  (silu via jax below)
         silu = lambda a: np.asarray(jax.nn.silu(jnp.asarray(a)))
         want = np.zeros_like(xt)
         for t in range(32):

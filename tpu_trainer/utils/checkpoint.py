@@ -99,10 +99,55 @@ def load_meta(path: str) -> dict:
 def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
     """Restore a TrainState onto the trainer's mesh/sharding (resharding as
     needed) plus the saved metadata. ``trainer`` is a
-    ``tpu_trainer.training.trainer.Trainer``."""
+    ``tpu_trainer.training.trainer.Trainer``.
+
+    Raises ValueError (naming the differing config fields) when the saved
+    model shapes don't match the trainer's — otherwise a stale checkpoint
+    dir surfaces as an impenetrable orbax shape error mid-restore (the
+    auto-resume path makes this easy to hit: same ``--checkpoint_dir``,
+    different ``--model_size``)."""
     path = os.path.abspath(path)  # orbax requires absolute paths
     meta = load_meta(path)
     shapes = jax.eval_shape(trainer._make_state, jax.random.PRNGKey(0))
+    saved_cfg = meta.get("model_config")
+    now = dataclasses.asdict(trainer.model_config)
+    # Cheap dict compare first: the common auto-resume case (identical
+    # config) must not pay a second full-model trace. Only on a config
+    # delta do we check whether it is SHAPE-bearing (dtype/dropout/knob
+    # changes restore fine), and a saved config this build can't even
+    # construct (renamed/removed fields across versions) counts as
+    # incompatible rather than dying on a bare TypeError.
+    if saved_cfg is not None and saved_cfg != now:
+        from tpu_trainer.models.gpt import GPT  # local: avoid cycle
+
+        known = {f.name for f in dataclasses.fields(GPTConfig)}
+        mismatch = any(k not in known for k in saved_cfg)
+        if not mismatch:
+            try:
+                saved_shapes = jax.eval_shape(
+                    lambda rng: GPT(GPTConfig(**saved_cfg)).init(
+                        rng, np.zeros((1, 8), np.int32)
+                    )["params"],
+                    jax.random.PRNGKey(0),
+                )
+                here = jax.tree_util.tree_map(
+                    lambda s: s.shape, shapes.params)
+                there = jax.tree_util.tree_map(
+                    lambda s: s.shape, saved_shapes)
+                mismatch = here != there
+            except Exception:
+                mismatch = True
+        if mismatch:
+            diff = sorted(
+                k for k in set(saved_cfg) | set(now)
+                if saved_cfg.get(k) != now.get(k)
+            )
+            raise ValueError(
+                f"checkpoint {path} holds an incompatible model "
+                f"(differing config fields: {', '.join(diff) or 'shapes'}); "
+                f"point --checkpoint_dir at a fresh directory, pass "
+                f"--no_auto_resume to start over, or match the saved config"
+            )
     abstract = jax.tree_util.tree_map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         shapes,
