@@ -102,6 +102,62 @@ class TestTransformerBlockPipeline:
         np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+class TestVocabShardedCE:
+    """ops/loss.py vocab_sharded_shifted_cross_entropy vs the fused oracle:
+    same loss, same d(x), same d(emb) — including a vocab that does NOT
+    divide by the stage count (the padded-overhang slice)."""
+
+    @pytest.mark.parametrize("vocab", [128, 130])
+    def test_matches_fused_loss_and_grads(self, vocab):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_trainer.ops.loss import (
+            fused_shifted_cross_entropy,
+            vocab_sharded_shifted_cross_entropy,
+        )
+
+        S, b, s, h = 4, 2, 16, 32
+        vs = -(-vocab // S)
+        mesh = _stage_mesh(S)
+        k = jax.random.PRNGKey(0)
+        emb = jax.random.normal(k, (vocab, h)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(1), (b, s, h))
+        labels = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, vocab)
+
+        def region(emb_p, xx, ll):
+            off = lax.axis_index(STAGE_AXIS) * vs
+            e_slice = lax.dynamic_slice(emb_p, (off, 0), (vs, h))
+            f = lambda e_, x_: vocab_sharded_shifted_cross_entropy(
+                e_, x_, ll, vocab=vocab, axis_name=STAGE_AXIS
+            )
+            loss, pull = jax.vjp(f, e_slice, xx)
+            de_s, dx_p = pull(jnp.float32(1.0))
+            dx = lax.psum(dx_p, STAGE_AXIS)
+            de = lax.psum(
+                lax.dynamic_update_slice(
+                    jnp.zeros((S * vs, h), jnp.float32), de_s, (off, 0)
+                )[:vocab],
+                STAGE_AXIS,
+            )
+            return loss, dx, de
+
+        run = jax.jit(shard_map(
+            region, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=(P(), P(), P()), axis_names={STAGE_AXIS},
+            check_vma=False,
+        ))
+        emb_p = jnp.pad(emb, ((0, S * vs - vocab), (0, 0)))
+        loss, dx, de = run(emb_p, x, labels)
+
+        oracle = lambda e_, x_: fused_shifted_cross_entropy(e_, x_, labels)
+        want = oracle(emb, x)
+        want_de, want_dx = jax.grad(oracle, argnums=(0, 1))(emb, x)
+        np.testing.assert_allclose(loss, want, rtol=1e-6)
+        np.testing.assert_allclose(dx, want_dx, atol=1e-6, rtol=1e-5)
+        np.testing.assert_allclose(de, want_de, atol=1e-6, rtol=1e-5)
+
+
 class _StrategyHarness:
     """Shared tiny-model runner for the strategy test classes (a plain
     mixin, NOT a Test class: subclassing a Test class would re-collect and
@@ -385,12 +441,114 @@ class Test1F1BSchedule(_StrategyHarness):
 
         tc = TrainingConfig(batch_size=4, max_seq_len=32,
                             mixed_precision="fp32")
-        with pytest.raises(NotImplementedError, match="sequence"):
-            Trainer(self._model_1f1b(), tc,
-                    ParallelConfig(MeshConfig(data=2, fsdp=1, sequence=2,
-                                              stage=2)))
+        # 1F1B x SP composes as of round 4: the combined-mesh trainer must
+        # simply construct (round 3 raised NotImplementedError here).
+        Trainer(self._model_1f1b(), tc,
+                ParallelConfig(MeshConfig(data=2, fsdp=1, sequence=2,
+                                          stage=2)))
         with pytest.raises(ValueError, match="pipeline_schedule"):
             dc.replace(self.MODEL, pipeline_schedule="wavefront")
+
+    def test_1f1b_with_sequence_parallel_matches_ddp(self):
+        """1F1B x SP (VERDICT r3 item 2): jointly-manual {stage, sequence}
+        with the manual backward — the head's next-token shift crosses
+        chunk boundaries via the replicated global labels."""
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        sp_1f1b = self._run(
+            MeshConfig(data=2, fsdp=1, sequence=2, stage=2), 4,
+            model=self._model_1f1b(),
+        )
+        assert ddp == pytest.approx(sp_1f1b, rel=1e-5)
+
+    def test_1f1b_moe_matches_gpipe_and_ddp(self):
+        """1F1B x MoE (VERDICT r3 item 2): the aux loss rides the manual
+        backward via the pre-scaled vjp seed. M=1 makes routing groups
+        identical to DDP (exact match); M=2 smokes the per-micro
+        estimator."""
+        import dataclasses as dc
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        moe = dc.replace(self.MODEL, num_experts=4, pipeline_microbatches=1,
+                         pipeline_schedule="1f1b")
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1,
+                        model=dc.replace(moe, pipeline_schedule="gpipe"))
+        ofob = self._run(MeshConfig(data=2, fsdp=1, stage=2, expert=2), 2,
+                         model=moe)
+        assert ddp == pytest.approx(ofob, rel=1e-5)
+        m2 = dc.replace(moe, pipeline_microbatches=2)
+        gpipe2 = self._run(
+            MeshConfig(data=2, fsdp=1, stage=2, expert=2), 2,
+            model=dc.replace(m2, pipeline_schedule="gpipe"))
+        ofob2 = self._run(MeshConfig(data=2, fsdp=1, stage=2, expert=2), 2,
+                          model=m2)
+        assert gpipe2 == pytest.approx(ofob2, rel=1e-5)
+
+
+class TestInterleavedSchedule(_StrategyHarness):
+    """Virtual-stage (Megatron-interleaved) 1F1B (VERDICT r3 item 3): each
+    device runs v non-contiguous layer chunks through the same
+    canonical-sequence manual schedule — loss-equivalent to DDP/GPipe with
+    a v x smaller per-tick stage latency (bubble ~(S-1)/(vM+S-1))."""
+
+    def _model_il(self, **kw):
+        import dataclasses as dc
+
+        return dc.replace(self.MODEL, pipeline_schedule="interleaved", **kw)
+
+    def test_interleaved_matches_gpipe_and_ddp(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        # S=2, v=2 over the 4-layer model (one layer per chunk), M=2.
+        il = self._run(MeshConfig(data=4, fsdp=1, stage=2), 2,
+                       model=self._model_il(pipeline_microbatches=2))
+        assert ddp == pytest.approx(il, rel=1e-5)
+
+    def test_interleaved_many_microbatches_zero3_remat(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        il = self._run(
+            MeshConfig(data=1, fsdp=4, stage=2), 8,
+            model=self._model_il(pipeline_microbatches=8,
+                                 gradient_checkpointing=True),
+            strategy="zero3",
+        )
+        assert ddp == pytest.approx(il, rel=1e-5)
+
+    def test_interleaved_with_sequence_parallel(self):
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        ddp = self._run(MeshConfig(data=-1, fsdp=1), 1)
+        il_sp = self._run(
+            MeshConfig(data=2, fsdp=1, sequence=2, stage=2), 4,
+            model=self._model_il(pipeline_microbatches=2),
+        )
+        assert ddp == pytest.approx(il_sp, rel=1e-5)
+
+    def test_interleaved_guards(self):
+        import dataclasses as dc
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+        from tpu_trainer.training.config import TrainingConfig
+        from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+        tc = TrainingConfig(batch_size=4, max_seq_len=32,
+                            mixed_precision="fp32")
+        with pytest.raises(ValueError, match="virtual"):
+            # 4 layers cannot split into 2 stages x 4 chunks.
+            Trainer(self._model_il(pipeline_virtual_stages=4), tc,
+                    ParallelConfig(MeshConfig(data=4, fsdp=1, stage=2)))
+        with pytest.raises(ValueError, match="divisible by the stage"):
+            # M=3 not divisible by S=2.
+            Trainer(self._model_il(pipeline_microbatches=3), tc,
+                    ParallelConfig(MeshConfig(data=4, fsdp=1, stage=2)))
+        with pytest.raises(ValueError, match="pipeline_virtual_stages"):
+            dc.replace(self.MODEL, pipeline_schedule="interleaved",
+                       pipeline_virtual_stages=1)
 
 
 class TestManualSeqDropoutDecorrelation:

@@ -118,12 +118,19 @@ class GPTConfig:
     # microbatches -> smaller pipeline bubble, smaller per-step matmuls.
     pipeline_microbatches: int = 0
     # Pipeline schedule: "gpipe" (AD of the forward scan — all M
-    # microbatch activations live at the bubble point) or "1f1b"
+    # microbatch activations live at the bubble point), "1f1b"
     # (manually scheduled interleaved backward — at most min(M, 2S-1)
     # stage inputs in flight, M-independent; stage blocks rematerialize
-    # in the backward). 1f1b requires a dense model (no MoE) and no
-    # sequence axis.
+    # in the backward), or "interleaved" (virtual-stage 1F1B: each device
+    # holds `pipeline_virtual_stages` non-contiguous layer chunks, cutting
+    # the bubble from (S-1)/(M+S-1) to ~(S-1)/(vM+S-1) at the cost of a
+    # ~v x larger saved-input window). All three compose with SP and MoE.
     pipeline_schedule: str = "gpipe"
+    # Layer chunks per device under pipeline_schedule="interleaved"
+    # (Megatron's virtual pipeline stages); ignored by other schedules.
+    # Requires num_layers % (stages * v) == 0 and microbatches % stages
+    # == 0.
+    pipeline_virtual_stages: int = 2
     # Counter-based dropout masks (ops/dropout.py) instead of threefry
     # bernoulli: same Bernoulli semantics, ~5x cheaper mask generation
     # (threefry masks measured ~9% of the headline step). Applies to the
@@ -182,10 +189,17 @@ class GPTConfig:
                 f"moe_top_k ({self.moe_top_k}) must be in "
                 f"[1, num_experts={self.num_experts}]"
             )
-        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+        if self.pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
                 f"unknown pipeline_schedule {self.pipeline_schedule!r}; "
-                f"choose gpipe or 1f1b"
+                f"choose gpipe, 1f1b, or interleaved"
+            )
+        if (self.pipeline_schedule == "interleaved"
+                and self.pipeline_virtual_stages < 2):
+            raise ValueError(
+                f"pipeline_schedule='interleaved' needs "
+                f"pipeline_virtual_stages >= 2 "
+                f"(got {self.pipeline_virtual_stages}); v=1 is plain 1f1b"
             )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
@@ -269,3 +283,15 @@ class GPTConfig:
         attn = 2 * h * h + 2 * h * kv  # q/o full, k/v grouped
         per_layer = attn + ffn + 2 * h
         return embed + self.num_layers * per_layer + h
+
+    def num_active_parameters(self) -> int:
+        """Parameters a single token actually flows through: for MoE, only
+        the ``moe_top_k`` routed experts' FFNs count (plus the router);
+        dense models: == ``num_parameters()``. This is the N that belongs
+        in the 6N FLOPs/token estimate — total-parameter MFU overstates
+        MoE utilization by ~E/top_k on the FFN share."""
+        if self.num_experts <= 0:
+            return self.num_parameters()
+        h, i = self.hidden_size, self.intermediate_size
+        inactive_ffn = (self.num_experts - self.moe_top_k) * 3 * h * i
+        return self.num_parameters() - self.num_layers * inactive_ffn

@@ -42,7 +42,10 @@ from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.ops import ring
 from tpu_trainer.ops.attention import flash_attention, reference_attention
 from tpu_trainer.ops.dropout import hash_dropout
-from tpu_trainer.ops.loss import fused_shifted_cross_entropy
+from tpu_trainer.ops.loss import (
+    fused_shifted_cross_entropy,
+    vocab_sharded_shifted_cross_entropy,
+)
 
 
 class RMSNorm(nn.Module):
@@ -689,9 +692,6 @@ def _sample(logits, rng, temperature: float, top_k: int):
     return jax.random.categorical(rng, logits)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("config", "max_new_tokens", "temperature", "top_k")
-)
 def generate_kv(
     params,
     rng: jax.Array,
@@ -721,7 +721,46 @@ def generate_kv(
     ``prompt_lens[r] + max_new_tokens`` real tokens, zero-filled beyond) —
     a mixed-length batch decodes in ONE call, where the reference's
     generator is batch-of-one (``infer.py:60-66``).
+
+    This eager wrapper validates ``prompt_lens`` host-side (the jitted body
+    only ever sees tracers, so it cannot); callers who jit *around*
+    ``generate_kv`` skip this check and get the clamped-lengths behavior
+    documented in the body.
     """
+    if prompt_lens is not None and not isinstance(
+        jnp.asarray(prompt_lens), jax.core.Tracer
+    ):
+        # Concrete lengths: fail loudly on impossible values — a length
+        # beyond the padded width would silently repack garbage (negative
+        # left-pad duplicates tokens and the attention window degenerates).
+        b, width = input_ids.shape
+        vals = np.asarray(prompt_lens)
+        if vals.shape != (b,) or (vals <= 0).any() or (vals > width).any():
+            raise ValueError(
+                f"prompt_lens must be [batch]={b} values in "
+                f"[1, {width}] (the padded width); got {vals}"
+            )
+    return _generate_kv_jit(
+        params, rng, input_ids, config=config,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, prompt_lens=prompt_lens,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "max_new_tokens", "temperature", "top_k")
+)
+def _generate_kv_jit(
+    params,
+    rng: jax.Array,
+    input_ids: jax.Array,
+    *,
+    config: GPTConfig,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+    prompt_lens: Optional[jax.Array],
+) -> jax.Array:
     import dataclasses as _dc
 
     if prompt_lens is not None:
@@ -744,19 +783,11 @@ def generate_kv(
     pad = None
     if prompt_lens is not None:
         prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
-        if not isinstance(prompt_lens, jax.core.Tracer):
-            # Concrete lengths (the usual non-jit call): fail loudly on
-            # impossible values — a length beyond the padded width would
-            # silently repack garbage (negative left-pad duplicates tokens
-            # and the attention window degenerates).
-            vals = np.asarray(prompt_lens)
-            if vals.shape != (b,) or (vals <= 0).any() or (
-                vals > prompt_len
-            ).any():
-                raise ValueError(
-                    f"prompt_lens must be [batch]={b} values in "
-                    f"[1, {prompt_len}] (the padded width); got {vals}"
-                )
+        # In here lengths are always tracers; out-of-range values (only
+        # possible when the caller jitted over the eager wrapper's
+        # validation) clamp to [1, padded width] rather than repacking
+        # garbage.
+        prompt_lens = jnp.clip(prompt_lens, 1, prompt_len)
         pad = (prompt_len - prompt_lens).astype(jnp.int32)     # [b]
         # Right-padded -> left-padded rows (shared decode frontier).
         cols = jax.lax.broadcasted_iota(jnp.int32, (b, prompt_len), 1)
@@ -845,16 +876,25 @@ def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
     gradient come out of ONE scheduled scan (``parallel/pipeline.py
     pipeline_1f1b``) and the usual ``value_and_grad`` around ``GPT.apply``
     is bypassed. This function replicates the model's embedding, stage
-    block, and head-loss computations exactly (same modules, same
-    ``fused_loss`` / materialized CE selection), assembling the full
-    parameter-gradient pytree: stacked layer grads from the schedule, the
-    tied embedding's gradient as head + lookup contributions, and the
-    final norm's from the head VJP.
+    block, and head-loss computations (same modules; the head+CE always
+    runs blockwise AND vocab-sharded over the stage axis here — the same
+    math as either ``fused_loss`` setting, computed as 1/S slices with
+    explicit collectives), assembling the full parameter-gradient pytree:
+    stacked layer grads from the schedule, the tied embedding's gradient
+    as head + lookup contributions, and the final norm's from the head
+    VJP.
 
     Dropout streams are folded per (global layer, microbatch) from the
     step rng directly — self-consistent and decorrelated, but a different
     (equally valid) stream than the GPipe path's ``make_rng`` derivation;
     loss-equivalence against GPipe holds exactly with dropout off.
+
+    Composes with sequence parallelism (a non-trivial ``sequence`` mesh
+    axis: the pipeline goes jointly manual over {stage, sequence}, the
+    blocks route through the in-region ring attention, and the head's CE
+    reads its next-token shift from the replicated global labels) and with
+    MoE (``stage_fwd`` returns the stage's aux sum; its gradient rides the
+    same stage vjp via a pre-scaled cotangent seed).
 
     Returns ``grad_fn(params, micro_ids, rng, loss_scale) ->
     ((loss * scale, loss), grads)``.
@@ -863,13 +903,13 @@ def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
 
     cfg = model.config
     S = mesh.shape["stage"]
-    lps = cfg.num_layers // S
+    v = (cfg.pipeline_virtual_stages
+         if cfg.pipeline_schedule == "interleaved" else 1)
+    lpc = cfg.num_layers // (S * v)  # layers per chunk
     M = num_microbatches
-    if cfg.num_experts > 0:
-        raise NotImplementedError(
-            "pipeline_schedule='1f1b' does not support MoE yet (the aux "
-            "loss does not ride the manual backward); use gpipe"
-        )
+    sq = mesh.shape.get(ring.SEQ_AXIS, 1)
+    manual_seq = ring.SEQ_AXIS if sq > 1 else None
+    with_aux = cfg.num_experts > 0
     needs_rng = cfg.dropout > 0.0 or cfg.attention_dropout > 0.0
     block_mod = TransformerBlock(cfg, deterministic=False)
     norm_mod = RMSNorm(dtype=cfg.compute_dtype)
@@ -882,14 +922,24 @@ def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
         emb = params["embed_tokens"]["embedding"]
         vocab, hidden = emb.shape
 
-        def stage_fwd(local_params, xm, micro_idx):
+        def stage_fwd(chunk_params, xm, micro_idx, chunk_idx):
             def one_layer(carry, scanned):
                 li, p = scanned
                 rngs = {}
                 if needs_rng:
-                    g_layer = jax.lax.axis_index("stage") * lps + li
-                    rngs = {"dropout": jax.random.fold_in(
-                        rng, g_layer * M + micro_idx)}
+                    # Global layer index: chunk `chunk_idx` of this device
+                    # is global stage chunk_idx*S + stage (v=1: == stage).
+                    g_stage = chunk_idx * S + jax.lax.axis_index("stage")
+                    g_layer = g_stage * lpc + li
+                    key = jax.random.fold_in(rng, g_layer * M + micro_idx)
+                    if manual_seq is not None:
+                        # Sequence shards see local slices and hash_dropout
+                        # keys by LOCAL positions: fold the shard index so
+                        # chunks don't repeat one mask (same rule as
+                        # pipeline_forward).
+                        key = jax.random.fold_in(
+                            key, jax.lax.axis_index(manual_seq))
+                    rngs = {"dropout": key}
                 (xc, aux), _ = block_mod.apply(
                     {"params": p}, carry, rngs=rngs)
                 return (xc, aux), None
@@ -898,42 +948,62 @@ def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
             if cfg.gradient_checkpointing:
                 run = jax.checkpoint(run, prevent_cse=False,
                                      policy=policies[cfg.remat_policy])
-            (y, _), _ = jax.lax.scan(
+            (y, aux), _ = jax.lax.scan(
                 run, (xm, jnp.zeros((), jnp.float32)),
-                (jnp.arange(lps), local_params),
+                (jnp.arange(lpc), chunk_params),
             )
-            return y
+            return (y, aux) if with_aux else y
 
-        def head_loss(y, e_param, norm_params, labels_mb):
-            xn = norm_mod.apply({"params": norm_params}, y)
-            if cfg.fused_loss:
-                return fused_shifted_cross_entropy(
-                    e_param, xn, labels_mb, chunk_size=cfg.loss_chunk_size
-                )
-            logits = (
-                xn @ e_param.astype(cfg.compute_dtype).T
-            ).astype(jnp.float32)
-            return jnp.mean(
-                optax_softmax_cross_entropy(logits[:, :-1, :],
-                                            labels_mb[:, 1:])
+        # --- vocab-sharded head (VERDICT r3 #1) --------------------------
+        # Each stage evaluates 1/S of the LM head + CE on the last stage's
+        # broadcast output; explicit pmax/psum over the stage axis stitch
+        # the softmax (ops/loss.py vocab_sharded_shifted_cross_entropy —
+        # custom_vjp, so AD never transposes a collective). Head FLOPs per
+        # microbatch total ONE full evaluation, split S ways.
+        v_s = -(-vocab // S)  # ceil: the last slice may overhang
+        emb_padded = jnp.pad(emb, ((0, S * v_s - vocab), (0, 0)))
+
+        def head_vjp(y_bc, labels_mb, micro_idx):
+            off = jax.lax.axis_index("stage") * v_s
+            e_slice = jax.lax.dynamic_slice(
+                emb_padded, (off, 0), (v_s, hidden)
             )
 
-        def head_vjp(y, labels_mb, micro_idx):
-            # Per-micro loss contributes loss_m / M to the mean; the
-            # cotangent additionally carries the fp16 loss scale.
             def f(yy, e_, nw_):
-                return head_loss(yy, e_, nw_, labels_mb)
+                xn = norm_mod.apply({"params": nw_}, yy)
+                return vocab_sharded_shifted_cross_entropy(
+                    e_, xn, labels_mb, vocab=vocab, axis_name="stage",
+                    chunk_size=cfg.loss_chunk_size, seq_axis=manual_seq,
+                )
 
-            loss_m, pull = jax.vjp(f, y, emb, params["norm"])
-            dy, de_head, dnorm = pull(
+            loss_m, pull = jax.vjp(f, y_bc, e_slice, params["norm"])
+            dy_part, de_slice, dnorm = pull(
                 jnp.asarray(loss_scale / M, jnp.float32))
-            # dy stays in the activation dtype (what AD would propagate);
-            # parameter-grad accumulators stay f32.
+            # dy: the pullback's x-cotangent is this stage's vocab-slice
+            # partial — one psum (in the activation dtype, what AD of the
+            # bf16 forward would move) makes it the full cotangent.
+            dy = jax.lax.psum(
+                dy_part.astype(cfg.compute_dtype), "stage"
+            )
+            # Parameter-grad accumulators stay f32; the norm grad is also
+            # a per-stage partial (linearity: psummed with the rest at the
+            # end of the schedule).
             return (loss_m / M,
                     dy,
-                    {"embedding": de_head.astype(jnp.float32),
+                    {"embedding_slice": de_slice.astype(jnp.float32),
                      "norm": jax.tree_util.tree_map(
                          lambda g: g.astype(jnp.float32), dnorm)})
+
+        def head_finalize(acc):
+            # Scatter this stage's [v_s, hidden] slice gradient into its
+            # rows of the full [vocab, hidden] table (other rows zero; the
+            # pipeline's final psum assembles the table from all stages).
+            off = jax.lax.axis_index("stage") * v_s
+            full = jax.lax.dynamic_update_slice(
+                jnp.zeros((S * v_s, hidden), jnp.float32),
+                acc["embedding_slice"], (off, 0),
+            )[:vocab]
+            return {"embedding": full, "norm": acc["norm"]}
 
         def emb_accum(acc, dx, ids_mb):
             # d(embedding lookup): scatter-add each token's cotangent row.
@@ -941,7 +1011,7 @@ def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
             return acc.at[flat].add(dx.reshape(-1, hidden))
 
         head_zeros = {
-            "embedding": jnp.zeros((vocab, hidden), jnp.float32),
+            "embedding_slice": jnp.zeros((v_s, hidden), jnp.float32),
             "norm": jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params["norm"]),
         }
@@ -950,10 +1020,34 @@ def pipeline_1f1b_value_and_grad(model: "GPT", mesh, num_microbatches: int):
         x = jnp.take(
             emb.astype(cfg.compute_dtype), ids, axis=0
         )  # nn.Embed semantics: cast table, then gather
-        loss_mean, dlayers, dhead, de_lookup = pipeline_1f1b(
-            params["layers"], x, ids, ids, stage_fwd, head_vjp,
-            head_zeros, emb_accum, emb_zeros, mesh, M,
-        )
+        import contextlib as _cl
+
+        seq_cm = (ring.sequence_parallel_manual(mesh) if manual_seq
+                  else _cl.nullcontext())
+        # aux cotangent per microbatch backward: d total_loss / d aux_layer
+        # = loss_scale / (M * num_layers * sq) — matching the GPipe
+        # estimator (mean over micros and seq shards, /num_layers in the
+        # model's loss assembly).
+        aux_args = {}
+        if with_aux:
+            aux_args = dict(
+                with_aux=True,
+                aux_seed=jnp.asarray(
+                    loss_scale / (M * cfg.num_layers * sq), jnp.float32),
+            )
+        with seq_cm:
+            out = pipeline_1f1b(
+                params["layers"], x, ids, ids, stage_fwd, head_vjp,
+                head_zeros, emb_accum, emb_zeros, mesh, M,
+                head_finalize=head_finalize, manual_seq_axis=manual_seq,
+                virtual_stages=v,
+                **aux_args,
+            )
+        if with_aux:
+            loss_mean, aux_raw, dlayers, dhead, de_lookup = out
+            loss_mean = loss_mean + aux_raw / (M * cfg.num_layers * sq)
+        else:
+            loss_mean, dlayers, dhead, de_lookup = out
         # The lookup's cotangent arrives unscaled by loss_scale/M? No — dx
         # flowed from head_vjp's scaled seed through the stage backwards,
         # so every gradient here already carries loss_scale / M per micro,

@@ -231,6 +231,11 @@ def pipeline_1f1b(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = STAGE_AXIS,
+    head_finalize: Callable = lambda acc: acc,
+    manual_seq_axis: Optional[str] = None,
+    with_aux: bool = False,
+    aux_seed: Optional[jax.Array] = None,
+    virtual_stages: int = 1,
 ) -> Any:
     """Interleaved forward/backward (1F1B-style) pipeline with MANUAL
     backward scheduling — the loss and every gradient come out of ONE scan.
@@ -246,162 +251,404 @@ def pipeline_1f1b(
     (stage-granular rematerialization), the same total compute as GPipe
     with per-block remat.
 
-    Schedule (per tick ``t`` of ``M + 2(S-1)``; every stage runs both
+    Schedule — expressed through ONE canonical work-item sequence shared by
+    every device. Forward item ``k`` covers (chunk ``(k mod Sv) div S``,
+    micro ``(k div Sv)*S + k mod S``) and is run by device ``s`` at tick
+    ``t = s + k``; backward item ``j`` is the same pairing with the chunk
+    order REVERSED, run by device ``s`` at tick ``t = (vS-1) + j +
+    (S-1-s)``. Because global stage ``g = c*S + s`` always hands to device
+    ``(s+1) mod S`` (chunk boundaries wrap the ring), one canonical
+    sequence + a per-device tick shift gives immediate-consume dataflow:
+    every activation/cotangent ppermuted at tick ``t`` is consumed at
+    ``t+1``. With ``v = 1`` this reduces exactly to classic 1F1B (fwd
+    micro ``t - s``, bwd micro ``t - 2(S-1) + s``); with ``v > 1`` it is
+    Megatron's interleaved schedule (device-0 warmup ``2(S-1) + (v-1)S``
+    chunk-slots), bubble ~``(S-1)/(vM+S-1)`` per tick-latency ``1/v`` of a
+    full stage. Per tick (``vM + (v+1)S - 2`` total; every stage runs both
     masked halves — SPMD):
 
-    - forward half: stage ``s`` runs micro ``i = t - s`` when valid, and
-      every stage evaluates the head loss + cotangent for that micro with
-      only the LAST stage's result kept (masked, NOT ``lax.cond``: the
-      head contains GSPMD collectives over the auto axes, and a
-      stage-predicated branch deadlocks them — see the in-body comment.
-      The head therefore runs S x (M + 2S - 2) times; acceptable while
-      stage counts are small relative to the model/head FLOP ratio).
-    - backward half: stage ``s`` runs the backward of micro
-      ``j = t - 2(S-1) + s`` when valid (at the last stage ``j == i``: the
-      1F1B "B right after F"); cotangents travel left by ppermute; layer
-      grads accumulate locally; stage 0 folds ``dx`` into the embedding
-      gradient via ``emb_accum`` (no [M, ...] cotangent buffer).
+    - forward half: stage ``s`` runs fwd item ``t - s`` when valid.
+    - head: on the (static, stage-UNIFORM — they depend only on ``t``, so
+      a real ``lax.cond`` is legal around collectives, unlike a
+      stage-predicated branch which deadlocks them) ticks where the LAST
+      chunk's forward completes at the last stage, that stage's fresh
+      output is broadcast over the axis by a masked psum and every stage
+      evaluates ``head_vjp`` on it. The head is expected to be SHARDED
+      over ``axis_name`` (each stage computes 1/S of the vocab —
+      ``ops/loss.py vocab_sharded_shifted_cross_entropy``), so one
+      microbatch's head costs one full head evaluation TOTAL, split S
+      ways: head compute per step is M x (1/S) per device, strictly less
+      than the non-pipelined trainer's.
+    - backward half: stage ``s`` runs backward item ``t - (vS-1) - (S-1)
+      + s`` when valid (at the last stage, a last-chunk backward item
+      coincides with the head's micro: the 1F1B "B right after F",
+      consuming this tick's ``dy``; other chunks' items consume the
+      cotangent ppermuted from device ``s+1``); cotangents travel left by
+      ppermute; layer grads accumulate per chunk; stage 0 folds chunk-0
+      ``dx`` into the embedding gradient via ``emb_accum`` (no [M, ...]
+      cotangent buffer).
 
     Args:
       stacked_params: ``[L, ...]`` leaves, sharded over ``axis_name``.
+        With ``virtual_stages > 1`` the stack is permuted here so each
+        device's shard holds its v chunks contiguously (global stage
+        ``g = c*S + s`` owns layers ``[g*Lc, (g+1)*Lc)``); gradients are
+        inverse-permuted back to natural layer order before returning.
       x: embedded activations ``[batch, seq, hidden]``.
       labels: ``[batch, seq]`` int labels (microbatched alongside x).
-      stage_fwd: ``(local_params, x_mb, micro_idx) -> y_mb`` — this stage's
-        layer block; must fold its dropout rngs from ``micro_idx`` exactly
-        like the GPipe path so the two schedules are grad-equivalent.
+      stage_fwd: ``(chunk_params, x_mb, micro_idx, chunk_idx) -> y_mb`` —
+        ONE chunk's layer block (``chunk_params`` leaves lead with
+        ``L/(S*v)``); must fold its dropout rngs from (global layer,
+        micro) exactly like the GPipe path so the schedules are
+        grad-equivalent.
+      virtual_stages: v layer chunks per device (``"interleaved"``);
+        1 = classic 1F1B. v > 1 requires ``M % S == 0`` (the canonical
+        sequence feeds micros in groups of S) and ``L % (S*v) == 0``.
       head_vjp: ``(y_mb, labels_mb, micro_idx) -> (loss, dy, dhead)`` —
-        per-micro loss (already scaled by 1/M and any loss scale), its
-        cotangent wrt y, and the head-parameter grads.
-      head_grad_zeros / emb_grad_zeros: zero pytrees for the accumulators.
+        per-micro loss (already scaled by 1/M and any loss scale,
+        REPLICATED over the axis), the FULL cotangent wrt y (already
+        psummed if computed from vocab shards), and this stage's PARTIAL
+        head-parameter grads (slice-local shapes allowed).
+      head_grad_zeros / emb_grad_zeros: zero pytrees for the accumulators
+        (``head_grad_zeros`` in head_vjp's partial shapes).
+      head_finalize: maps the accumulated partial head grads to full-shape
+        per-stage contributions (e.g. scatter a vocab slice to its rows);
+        runs once inside the manual region, before the final psum.
       emb_accum: ``(acc, dx_mb, ids_mb) -> acc`` — folds a micro's input
         cotangent into the embedding gradient at that micro's token ids
         (runs on stage 0 only).
+      manual_seq_axis: jointly-manual SP x PP, as ``pipeline_forward``:
+        ``x``/``input_ids`` enter sequence-sharded, the stage body runs the
+        ring attention in-region, and ``labels`` stay GLOBAL (the head's
+        next-token shift reads across chunk boundaries from them —
+        ``ops/loss.py``). Layer/head/embedding grads are additionally
+        psummed over the sequence axis at the end.
+      with_aux: ``stage_fwd`` returns ``(y, aux_scalar)`` (the MoE
+        load-balance + z terms, summed over this stage's layers). The
+        forward halves accumulate aux over real ticks, and each backward
+        seeds the aux output's cotangent with ``aux_seed`` (the caller
+        folds 1/M, 1/num_layers, the loss scale, and any sequence-shard
+        mean into it) — so the aux gradient rides the SAME stage vjp, no
+        second backward.
+      aux_seed: scalar f32 cotangent for the aux output per microbatch
+        backward (required when ``with_aux``).
 
     Returns ``(loss_sum, dlayers_stacked, dhead, demb)`` — loss summed over
-    microbatches (caller already folded 1/M into head_vjp).
+    microbatches (caller already folded 1/M into head_vjp) — with the raw
+    aux sum appended when ``with_aux``:
+    ``(loss_sum, aux_sum, dlayers, dhead, demb)``; ``aux_sum`` is summed
+    over microbatches and all layers (psummed over stage and, under SP,
+    over sequence shards — divide by M [and sq] to get the estimator the
+    GPipe path reports).
     """
+    import numpy as np
+
     S = mesh.shape[axis_name]
+    v = virtual_stages
     b, s, h = x.shape
     M = num_microbatches
     if b % M != 0:
         raise ValueError(f"batch {b} not divisible by M={M}")
-    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
-    if n_layers % S != 0:
+    if with_aux and aux_seed is None:
+        raise ValueError("with_aux=True requires aux_seed")
+    if v > 1 and M % S != 0:
         raise ValueError(
-            f"num_layers {n_layers} not divisible by {S} pipeline stages"
+            f"interleaved schedule needs microbatches ({M}) divisible by "
+            f"stages ({S})"
+        )
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_layers % (S * v) != 0:
+        raise ValueError(
+            f"num_layers {n_layers} not divisible by stages*virtual "
+            f"({S}*{v})"
         )
     mb = b // M
-    W = min(M, 2 * (S - 1) + 1)  # max in-flight stage inputs (M-independent)
+
+    # --- static schedule tables (v=1 reduces to the classic closed forms:
+    # fwd micro t-s, bwd micro t-2(S-1)+s, head window [S-1, M+S-1)) ------
+    K = v * M                              # work items per device per pass
+    ks = np.arange(K)
+    g0, rem = np.divmod(ks, S * v)
+    fwd_chunk_tab = rem // S
+    fwd_micro_tab = g0 * S + rem % S
+    bwd_chunk_tab = (v - 1) - fwd_chunk_tab   # reversed chunk order
+    bwd_micro_tab = fwd_micro_tab
+    off = v * S - 1                        # last stage: B-of-(c=v-1,i=0)
+    T = v * M + (v + 1) * S - 2            # ticks in one schedule
+    t_all = np.arange(T)
+    k_h = t_all - (S - 1)                  # head <- last stage's fwd item
+    k_hc = np.clip(k_h, 0, K - 1)
+    head_on_tab = (k_h >= 0) & (k_h < K) & (fwd_chunk_tab[k_hc] == v - 1)
+    head_micro_tab = np.where(head_on_tab, fwd_micro_tab[k_hc], 0)
+
+    # Saved-input window per (device, chunk): exact max in-flight count
+    # from a static timeline simulation (v=1 gives min(M, 2S-1)). Each
+    # chunk's items write/consume in micro order, so slot ``i mod W`` is
+    # collision-free whenever W bounds the overlap count. The read tick
+    # counts as LIVE (``tr >= w``, not ``>``): within a tick the forward
+    # half writes its slot BEFORE the backward half reads, so a slot
+    # written at some micro's read tick would clobber it first — the
+    # off-by-one that shrank W to 2 at (S=2, M>2) and corrupted the
+    # gradients the round-3 closed form (2S-1 = 3) got right.
+    W = 1
+    for s_ in range(S):
+        for c_ in range(v):
+            tw = s_ + ks[fwd_chunk_tab == c_]                 # write ticks
+            tr = off + ks[bwd_chunk_tab == c_] + (S - 1 - s_)  # read ticks
+            live = np.array([
+                int(np.sum((tw <= w) & (tr >= w))) for w in tw
+            ])
+            W = max(W, int(live.max()))
+    W = min(W, M)
+
+    lc = n_layers // (S * v)               # layers per chunk
+
+    def _permute(tree):
+        # Natural [L, ...] -> device-major order: position (s, c, rl) so a
+        # `stage` shard holds its v chunks contiguously. Identity at v=1.
+        return jax.tree_util.tree_map(
+            lambda p: p.reshape((v, S, lc) + p.shape[1:])
+            .swapaxes(0, 1).reshape((-1,) + p.shape[1:]),
+            tree,
+        )
+
+    def _unpermute(tree):
+        return jax.tree_util.tree_map(
+            lambda p: p.reshape((S, v, lc) + p.shape[1:])
+            .swapaxes(0, 1).reshape((-1,) + p.shape[1:]),
+            tree,
+        )
+
+    if v > 1:
+        stacked_params = _permute(stacked_params)
 
     def staged(local_params, x_local, ids_local, labels_local):
         stage = lax.axis_index(axis_name)
         is_last = stage == S - 1
         is_first = stage == 0
         s_l = x_local.shape[1]
-        # Strided microbatching, as pipeline_forward.
+        # Strided microbatching, as pipeline_forward. Labels keep their OWN
+        # length: global under SP (the head shift needs the next chunk's
+        # first token), == s_l otherwise.
         micro = x_local.reshape(mb, M, s_l, h).transpose(1, 0, 2, 3)
         iid = ids_local.reshape(mb, M, s_l).transpose(1, 0, 2)
-        lab = labels_local.reshape(mb, M, s_l).transpose(1, 0, 2)
+        lab = labels_local.reshape(mb, M, labels_local.shape[1]).transpose(
+            1, 0, 2)
 
         fwd_perm = [(i, (i + 1) % S) for i in range(S)]
         bwd_perm = [(i, (i - 1) % S) for i in range(S)]
 
+        # Canonical-sequence tables (device constants).
+        fwd_chunk = jnp.asarray(fwd_chunk_tab, jnp.int32)
+        fwd_micro = jnp.asarray(fwd_micro_tab, jnp.int32)
+        bwd_chunk = jnp.asarray(bwd_chunk_tab, jnp.int32)
+        bwd_micro = jnp.asarray(bwd_micro_tab, jnp.int32)
+        head_on_t = jnp.asarray(head_on_tab)
+        head_micro_t = jnp.asarray(head_micro_tab, jnp.int32)
+
+        # Local chunk view: [L/S, ...] -> [v, L/(S*v), ...] (the global
+        # permutation put this device's chunks contiguously).
+        local_v = jax.tree_util.tree_map(
+            lambda p: p.reshape((v, lc) + p.shape[1:]), local_params
+        )
         dlayers0 = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), local_params
+            lambda p: jnp.zeros(p.shape, jnp.float32), local_v
         )
         carry0 = (
             jnp.zeros((mb, s_l, h), x_local.dtype),   # inbound fwd act
             jnp.zeros((mb, s_l, h), x_local.dtype),   # inbound cotangent
-            jnp.zeros((W, mb, s_l, h), x_local.dtype),  # saved stage inputs
+            # saved stage-inputs, one ring buffer per chunk
+            jnp.zeros((v, W, mb, s_l, h), x_local.dtype),
             dlayers0,
             head_grad_zeros,
             emb_grad_zeros,
             jnp.zeros((), jnp.float32),               # loss acc
+            jnp.zeros((), jnp.float32),               # aux acc
         )
 
-        def tick(carry, t):
-            f_mov, b_mov, saved, dlayers, dhead, demb, loss_acc = carry
-
-            # ---- forward half -------------------------------------------
-            i_f = t - stage
-            f_valid = jnp.logical_and(i_f >= 0, i_f < M)
-            i_fc = jnp.clip(i_f, 0, M - 1)
-            x_in = jnp.where(is_first, micro[i_fc], f_mov)
-            y = stage_fwd(local_params, x_in, i_fc)
-            # Ring-buffer the stage input (guarded: invalid ticks must not
-            # clobber a live slot).
-            slot = i_fc % W
-            prev = lax.dynamic_index_in_dim(saved, slot, keepdims=False)
-            saved = lax.dynamic_update_index_in_dim(
-                saved, jnp.where(f_valid, x_in, prev), slot, 0
+        def chunk_of(tree, c):
+            return jax.tree_util.tree_map(
+                lambda p: lax.dynamic_index_in_dim(p, c, keepdims=False),
+                tree,
             )
 
-            # Head loss + cotangent for the micro this stage just
-            # forwarded; only the LAST stage's result is real. Computed
-            # unconditionally with a mask: the head math contains
-            # GSPMD-inserted collectives over the auto (data) axes, and a
-            # lax.cond whose predicate is the stage index would make only
-            # some devices enter them — a rendezvous deadlock (observed on
-            # the CPU mesh). Uniform SPMD control flow or nothing.
-            loss_i, dy_i, dhead_i = head_vjp(y, lab[i_fc], i_fc)
-            gate = jnp.where(jnp.logical_and(f_valid, is_last), 1.0, 0.0)
-            loss_acc = loss_acc + gate * loss_i
-            dhead = jax.tree_util.tree_map(
-                lambda a, g: a + gate * g, dhead, dhead_i
+        def tick(carry, t):
+            (f_mov, b_mov, saved, dlayers, dhead, demb, loss_acc,
+             aux_acc) = carry
+
+            # ---- forward half -------------------------------------------
+            k_f = t - stage
+            f_valid = jnp.logical_and(k_f >= 0, k_f < K)
+            k_fc = jnp.clip(k_f, 0, K - 1)
+            c_f = fwd_chunk[k_fc]
+            i_f = fwd_micro[k_fc]
+            # Fresh micros enter at (stage 0, chunk 0); everything else
+            # consumes the ppermuted activation (chunk boundaries included
+            # — global stage c*S+S-1 -> (c+1)*S+0 rides the same ring hop).
+            x_in = jnp.where(jnp.logical_and(is_first, c_f == 0),
+                             micro[i_f], f_mov)
+            cp_f = chunk_of(local_v, c_f)
+            if with_aux:
+                y, aux_f = stage_fwd(cp_f, x_in, i_f, c_f)
+                aux_acc = aux_acc + jnp.where(f_valid, aux_f, 0.0)
+            else:
+                y = stage_fwd(cp_f, x_in, i_f, c_f)
+            # Ring-buffer the stage input per chunk (guarded: invalid
+            # ticks must not clobber a live slot).
+            slot = i_f % W
+            prev = saved[c_f, slot]
+            saved = lax.dynamic_update_slice(
+                saved,
+                jnp.where(f_valid, x_in, prev)[None, None],
+                (c_f, slot, 0, 0, 0),
+            )
+
+            # Head loss + cotangent for the micro whose LAST chunk the
+            # last stage just forwarded. Gated by a lax.cond whose
+            # predicate depends only on t — uniform across every device,
+            # so the collectives inside (the manual vocab-shard psums AND
+            # the GSPMD auto-axis ones) are entered by all of them
+            # together. A stage-index predicate here would deadlock; a
+            # uniform one is the ordinary collectives-under-cond pattern
+            # the fp16 skip-step already uses.
+            head_on = head_on_t[t]
+            i_h = head_micro_t[t]
+
+            def do_head(ops):
+                y_, dhead_, loss_ = ops
+                # Broadcast the last stage's output over the axis (masked
+                # psum); each stage then computes its 1/S vocab slice of
+                # the loss and returns the psummed full dy.
+                y_bc = lax.psum(
+                    jnp.where(is_last, y_, jnp.zeros_like(y_)), axis_name
+                )
+                loss_i, dy_, dhead_i = head_vjp(y_bc, lab[i_h], i_h)
+                loss_ = loss_ + jnp.where(is_last, loss_i, 0.0)
+                dhead_ = jax.tree_util.tree_map(
+                    jnp.add, dhead_, dhead_i
+                )
+                return loss_, dhead_, dy_.astype(x_local.dtype)
+
+            def skip_head(ops):
+                y_, dhead_, loss_ = ops
+                return (loss_, dhead_,
+                        jnp.zeros(y_.shape, x_local.dtype))
+
+            loss_acc, dhead, dy_h = lax.cond(
+                head_on, do_head, skip_head, (y, dhead, loss_acc)
             )
 
             # ---- backward half ------------------------------------------
-            j_b = t - 2 * (S - 1) + stage
-            b_valid = jnp.logical_and(j_b >= 0, j_b < M)
-            j_bc = jnp.clip(j_b, 0, M - 1)
-            # At the last stage j == i: consume this tick's dy directly.
-            # Cotangents travel in the activation dtype — exactly what AD
-            # of the bf16 forward would propagate between stages.
-            dy = jnp.where(is_last, dy_i, b_mov).astype(x_local.dtype)
-            x_saved = lax.dynamic_index_in_dim(saved, j_bc % W,
-                                               keepdims=False)
+            j_b = t - off - (S - 1) + stage
+            b_valid = jnp.logical_and(j_b >= 0, j_b < K)
+            j_bc = jnp.clip(j_b, 0, K - 1)
+            c_b = bwd_chunk[j_bc]
+            i_b = bwd_micro[j_bc]
+            # A last-chunk item at the last stage consumes this tick's dy
+            # (its head fired this very tick); every other item consumes
+            # the cotangent ppermuted from the right neighbor. Cotangents
+            # travel in the activation dtype — exactly what AD of the bf16
+            # forward would propagate between stages.
+            dy = jnp.where(jnp.logical_and(is_last, c_b == v - 1),
+                           dy_h, b_mov).astype(x_local.dtype)
+            x_saved = saved[c_b, i_b % W]
+            cp_b = chunk_of(local_v, c_b)
             _, pullback = jax.vjp(
-                lambda p, xx: stage_fwd(p, xx, j_bc), local_params, x_saved
+                lambda p, xx: stage_fwd(p, xx, i_b, c_b), cp_b, x_saved
             )
-            dp_j, dx_j = pullback(dy)
+            if with_aux:
+                # One pullback carries BOTH cotangents: the activation's
+                # and the aux scalar's (pre-scaled by the caller). Invalid
+                # ticks are masked below via bgate/fgate, so the seed
+                # itself needs no gate.
+                dp_j, dx_j = pullback((dy, aux_seed))
+            else:
+                dp_j, dx_j = pullback(dy)
             bgate = jnp.where(b_valid, 1.0, 0.0)
             dlayers = jax.tree_util.tree_map(
-                lambda a, g: a + bgate * g, dlayers, dp_j
+                lambda a, g: lax.dynamic_update_index_in_dim(
+                    a,
+                    lax.dynamic_index_in_dim(a, c_b, keepdims=False)
+                    + bgate * g,
+                    c_b, 0,
+                ),
+                dlayers, dp_j,
             )
 
             # Same uniformity rule for the embedding-gradient fold: run it
-            # everywhere, zero the contribution off stage 0.
-            fgate = jnp.where(jnp.logical_and(b_valid, is_first), 1.0, 0.0)
+            # everywhere, zero the contribution off (stage 0, chunk 0).
+            fgate = jnp.where(
+                jnp.logical_and(jnp.logical_and(b_valid, is_first),
+                                c_b == 0), 1.0, 0.0)
             demb = emb_accum(demb, dx_j.astype(jnp.float32) * fgate,
-                             iid[j_bc])
+                             iid[i_b])
 
             f_mov_next = lax.ppermute(y, axis_name, fwd_perm)
             b_mov_next = lax.ppermute(
                 (dx_j * bgate).astype(x_local.dtype), axis_name, bwd_perm)
             return (f_mov_next, b_mov_next, saved, dlayers, dhead, demb,
-                    loss_acc), None
+                    loss_acc, aux_acc), None
 
-        (_, _, _, dlayers, dhead, demb, loss_acc), _ = lax.scan(
-            tick, carry0, jnp.arange(M + 2 * (S - 1))
+        (_, _, _, dlayers, dhead, demb, loss_acc, aux_acc), _ = lax.scan(
+            tick, carry0, jnp.arange(T)
+        )
+        # [v, lc, ...] chunk grads -> this device's [L/S, ...] shard.
+        dlayers = jax.tree_util.tree_map(
+            lambda p: p.reshape((v * lc,) + p.shape[2:]), dlayers
         )
         loss = lax.psum(loss_acc, axis_name)
-        dhead = jax.tree_util.tree_map(
-            lambda g: lax.psum(g, axis_name), dhead
-        )
-        demb = jax.tree_util.tree_map(
-            lambda g: lax.psum(g, axis_name), demb
-        )
+        # Partial (slice-local) head grads -> full-shape contributions,
+        # then one psum assembles them (each stage's slice lands in its
+        # own rows; every other row is zero).
+        dhead = head_finalize(dhead)
+        grad_axes = ((axis_name,) if manual_seq_axis is None
+                     else (axis_name, manual_seq_axis))
+
+        def _all_reduce(g):
+            for ax in grad_axes:
+                g = lax.psum(g, ax)
+            return g
+
+        # Under joint SP every gradient is additionally a per-token-chunk
+        # partial: psum over the sequence axis too (the GPipe path gets
+        # this from shard_map's transpose; here it is explicit).
+        dhead = jax.tree_util.tree_map(_all_reduce, dhead)
+        demb = jax.tree_util.tree_map(_all_reduce, demb)
+        if manual_seq_axis is not None:
+            dlayers = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, manual_seq_axis), dlayers
+            )
+        if with_aux:
+            aux = lax.psum(aux_acc, axis_name)
+            if manual_seq_axis is not None:
+                aux = lax.psum(aux, manual_seq_axis)
+            return loss, aux, dlayers, dhead, demb
         return loss, dlayers, dhead, demb
 
     layer_specs = jax.tree_util.tree_map(
         lambda leaf: P(axis_name, *([None] * (leaf.ndim - 1))), stacked_params
     )
+    seq = manual_seq_axis
+    x_spec = P() if seq is None else P(None, seq, None)
+    ids_spec = P() if seq is None else P(None, seq)
+    manual = {axis_name} if seq is None else {axis_name, seq}
+    outs = ((P(), layer_specs, P(), P()) if not with_aux
+            else (P(), P(), layer_specs, P(), P()))
     fn = shard_map(
         staged,
         mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P()),
-        out_specs=(P(), layer_specs, P(), P()),
-        axis_names={axis_name},
+        # labels stay REPLICATED over the seq axis (the head's next-token
+        # shift reads across chunk boundaries).
+        in_specs=(layer_specs, x_spec, ids_spec, P()),
+        out_specs=outs,
+        axis_names=manual,
         check_vma=False,
     )
-    return fn(stacked_params, x, input_ids, labels)
+    out = fn(stacked_params, x, input_ids, labels)
+    if v > 1:
+        # Layer grads come back in the schedule's device-major order;
+        # restore natural layer order for the optimizer/checkpoint layout.
+        out = list(out)
+        out[-3] = _unpermute(out[-3])
+        out = tuple(out)
+    return out
