@@ -58,15 +58,23 @@ def _build_parser():
     p.add_argument("--mesh-stage", type=int, default=1)
     p.add_argument("--strategy", default=None,
                    help="replicated | zero2 | zero3 (reference spellings ok)")
+    p.add_argument("--offload", action="store_true",
+                   help="host-offload optimizer state (pinned_host stream)")
+    p.add_argument("--offload-dtype", default="float32",
+                   help="offloaded-state storage: float32 | bfloat16 | int8")
     p.add_argument("--table", action="store_true",
                    help="run the method x chips scaling table")
     p.add_argument("--update-results", action="store_true",
                    help="rewrite the scaling table in benchmarks/results.md")
+    p.add_argument("--validate", action="store_true",
+                   help="run the on-hardware validation lane "
+                        "(tpu_trainer.validate) instead of benchmarking")
     return p
 
 
 def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
-              remat, mesh_cfg, strategy, devices=None):
+              remat, mesh_cfg, strategy, devices=None, offload=False,
+              offload_dtype="float32"):
     """One measured config -> result dict. ``batch_size`` is per data shard
     (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
@@ -104,7 +112,9 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         log_interval=10**9,
     )
     trainer = Trainer(model_config, training_config,
-                      ParallelConfig(mesh_cfg, strategy or "replicated"),
+                      ParallelConfig(mesh_cfg, strategy or "replicated",
+                                     cpu_offload=offload,
+                                     offload_dtype=offload_dtype),
                       mesh=mesh)
 
     loader = create_dummy_dataloader(
@@ -161,6 +171,9 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     return {
         "model_size": model_size,
         "params": model_config.num_parameters(),
+        # MoE: MFU below is computed against ACTIVE params (top-k experts
+        # per token); == params for dense models.
+        "active_params": model_config.num_active_parameters(),
         "batch_size": batch_size,
         "global_batch": trainer.global_batch_size,
         "seq_len": seq_len,
@@ -170,6 +183,8 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         "n_chips": n_chips,
         "mesh": dict(mesh.shape),
         "strategy": strategy or "replicated",
+        "offload": bool(trainer.cpu_offload),
+        "offload_dtype": offload_dtype if trainer.cpu_offload else None,
         "elapsed_s": round(elapsed, 3),
         "tok_per_sec": round(tok_per_sec, 1),
         "tok_per_sec_per_chip": round(tok_per_sec / n_chips, 1),
@@ -235,6 +250,10 @@ def format_table(rows) -> str:
     ]
     for r in rows:
         mem = f"{r['peak_mem_gb']:.2f} GB" if r["peak_mem_gb"] else "n/a"
+        if r["peak_mem_gb"] and r.get("peak_mem_source") == "compiled":
+            # XLA memory_analysis of the step executable (the axon tunnel
+            # hides runtime memory_stats) — arguments+outputs+temps-aliased.
+            mem += " (compiled)"
         mfu_s = f"{100 * r['mfu']:.1f}%" if r["mfu"] else "n/a"
         eff = (f"{100 * r['scaling_efficiency']:.0f}%"
                if r.get("scaling_efficiency") else "—")
@@ -288,6 +307,24 @@ def main() -> None:
 
         jax.config.update("jax_platforms", plat)
     args = _build_parser().parse_args()
+    if args.seq_len > 2048 and "scoped_vmem" not in os.environ.get(
+            "LIBTPU_INIT_ARGS", ""):
+        # The flash backward keeps full-sequence q/do/dq row blocks in
+        # VMEM (grid walks key blocks); past s=2048 that overflows the
+        # compiler's default 16 MB scoped-VMEM budget. v5e has 128 MB of
+        # physical VMEM — raise the scope before libtpu loads (measured:
+        # unlocks s=4096/8192; see benchmarks/results.md sequence
+        # scaling).
+        os.environ["LIBTPU_INIT_ARGS"] = (
+            os.environ.get("LIBTPU_INIT_ARGS", "")
+            + " --xla_tpu_scoped_vmem_limit_kib=49152"
+        ).strip()
+    if args.validate:
+        from tpu_trainer.validate import main as validate_main
+
+        # --tpu: bench.py is the on-hardware driver — a silent CPU
+        # fallback must FAIL, not skip the kernel checks and exit green.
+        sys.exit(validate_main(["--tpu"]))
     if args.table:
         rows = run_table(args)
         print(format_table(rows))
@@ -310,6 +347,7 @@ def main() -> None:
         seq_len=args.seq_len, steps=args.steps, accum=args.accum,
         use_flash=bool(args.flash), remat=_remat(args),
         mesh_cfg=mesh_cfg, strategy=args.strategy,
+        offload=args.offload, offload_dtype=args.offload_dtype,
     )
     result = {
         "metric": "train_tokens_per_sec",

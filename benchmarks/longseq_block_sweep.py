@@ -1,0 +1,92 @@
+"""Block-shape sweep for the flash kernel's long-sequence STREAMING path.
+
+Round 3 tuned block shapes at s=1024 only (`ops/flash.py:58-60`); the
+streaming path (s > block) first ran on hardware in round 4, where
+1024x1024 blocks turned out to overflow the default 16 MB scoped VMEM.
+This sweep times fwd+bwd at s in {2048, 4096, 8192} across candidate
+(block_q, block_k) pairs under the raised VMEM scope the bench uses for
+long sequences, to justify the streaming defaults with measurements::
+
+    python benchmarks/longseq_block_sweep.py [--rate 0.1]
+
+Prints one line per (s, bq, bk): ms/iter and achieved TFLOP/s (causal
+attention FLOPs 2*2*s^2*d per head-batch... reported as the PaLM full-S^2
+convention divided by 2 for causality — the same convention either way
+across rows, so relative ordering is what matters).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "scoped_vmem" not in os.environ.get("LIBTPU_INIT_ARGS", ""):
+    os.environ["LIBTPU_INIT_ARGS"] = (
+        os.environ.get("LIBTPU_INIT_ARGS", "")
+        + " --xla_tpu_scoped_vmem_limit_kib=49152"
+    ).strip()
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rate", type=float, default=0.1,
+                   help="attention dropout rate (0 disables the mask path)")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+
+    from tpu_trainer.ops.flash import flash_attention
+
+    assert any(d.platform == "tpu" for d in jax.devices())
+    h, d = 12, 64
+    rng = jax.random.PRNGKey(0)
+    for s in (2048, 4096, 8192):
+        b = 8192 // s  # constant tokens per call
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
+        flops = 4 * b * h * s * s * d / 2  # causal fwd; bwd adds ~2x
+
+        for bq, bk in ((512, 512), (1024, 512), (512, 1024), (1024, 1024),
+                       (2048, 512)):
+            if s % bq or s % bk or bq > s or bk > s:
+                continue
+
+            def run(qq, kk, vv):
+                def loss(vv_):
+                    return jnp.sum(flash_attention(
+                        qq, kk, vv_, block_q=bq, block_k=bk,
+                        dropout_rate=args.rate,
+                        dropout_rng=jax.random.PRNGKey(5),
+                    ).astype(jnp.float32))
+
+                return jax.value_and_grad(loss)(vv)
+
+            try:
+                f = jax.jit(run)
+                out = f(q, k, v)
+                jax.block_until_ready(out)
+                float(out[0])
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(args.iters):
+                        out = f(q, k, v)
+                    float(out[0])  # sync (axon: host read blocks)
+                    best = min(best, (time.perf_counter() - t0) / args.iters)
+                print(f"s={s} bq={bq} bk={bk}: {best * 1e3:8.3f} ms  "
+                      f"~{3 * flops / best / 1e12:6.1f} TF/s (fwd+bwd)")
+            except Exception as e:  # noqa: BLE001 - sweep must survive OOMs
+                print(f"s={s} bq={bq} bk={bk}: FAILED "
+                      f"({str(e).splitlines()[0][:90]})")
+
+
+if __name__ == "__main__":
+    main()

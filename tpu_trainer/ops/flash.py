@@ -874,8 +874,8 @@ def flash_attention(
     v: jax.Array,
     *,
     causal: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: bool = False,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
@@ -914,10 +914,41 @@ def flash_attention(
     # the O(seq^2) path. (Dropout masks generate in fixed 512x512 tiles
     # keyed by absolute coordinates — see _keep — so the backward's
     # different block shape still sees the identical mask.)
+    explicit_q, explicit_k = block_q is not None, block_k is not None
+    block_q = block_q if explicit_q else DEFAULT_BLOCK_Q
+    block_k = block_k if explicit_k else DEFAULT_BLOCK_K
     block_q = next((blk for blk in (block_q, 512, 256, 128)
                     if blk <= s and s % blk == 0), block_q)
     block_k = next((blk for blk in (block_k, 512, 256, 128)
                     if blk <= s and s % blk == 0), block_k)
+    # Multi-block STREAMING (s > block): the [block_q, block_k] f32 score
+    # block plus its exp/rotation/dropout temporaries must fit Mosaic's
+    # 16 MB scoped VMEM per software-pipelined iteration; 1024x1024 fits
+    # only as the single-block layout (s == block — no pipelining across
+    # k blocks). Measured on v5e at s=2048: the 1024-block streaming
+    # forward needs 18.9 MB and OOMs the scope, so DEFAULT streaming caps
+    # at the 512 shape (the round-2 default; the backward already runs
+    # 512s) — UNLESS the caller raised the scoped-VMEM limit
+    # (``LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=...``, which
+    # bench.py does for s > 2048): under the raised scope the 1024 blocks
+    # fit and measure ~18% faster at s=4096
+    # (benchmarks/longseq_block_sweep.py). Explicitly-passed block sizes
+    # are always honored.
+    import os as _os
+    import re as _re
+
+    _m = _re.search(r"scoped_vmem_limit_kib=(\d+)",
+                    _os.environ.get("LIBTPU_INIT_ARGS", ""))
+    # 1024-block streaming needs ~19 MB of scope: only an explicit limit
+    # comfortably above that counts as "raised" (a pinned 16 MB default
+    # must still get the 512 cap).
+    scope_raised = _m is not None and int(_m.group(1)) >= 20 * 1024
+    if (not explicit_q and not scope_raised and s > block_q
+            and block_q > 512 and s % 512 == 0):
+        block_q = 512
+    if (not explicit_k and not scope_raised and s > block_k
+            and block_k > 512 and s % 512 == 0):
+        block_k = 512
     # Compiled Mosaic lowering supports d=64 (two heads per program, lane
     # width 128) and d multiples of 128; other head dims take the XLA
     # fallback below (interpret mode has no lane constraint).
