@@ -62,6 +62,10 @@ def _build_parser():
                    help="host-offload optimizer state (pinned_host stream)")
     p.add_argument("--offload-dtype", default="float32",
                    help="offloaded-state storage: float32 | bfloat16 | int8")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="MoE: routed experts per FFN (0 = dense); MFU is "
+                        "reported against ACTIVE params")
+    p.add_argument("--moe-top-k", type=int, default=1)
     p.add_argument("--table", action="store_true",
                    help="run the method x chips scaling table")
     p.add_argument("--update-results", action="store_true",
@@ -74,7 +78,7 @@ def _build_parser():
 
 def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
               remat, mesh_cfg, strategy, devices=None, offload=False,
-              offload_dtype="float32"):
+              offload_dtype="float32", num_experts=0, moe_top_k=1):
     """One measured config -> result dict. ``batch_size`` is per data shard
     (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
@@ -98,6 +102,11 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         dropout=0.1,
         attention_dropout=0.1,
     )
+    if num_experts:
+        # MoE variant of the geometry: every FFN becomes `num_experts`
+        # routed experts (models/moe.py); z-loss at the recommended 1e-3.
+        common.update(num_experts=num_experts, moe_top_k=moe_top_k,
+                      router_z_weight=1e-3)
     if model_size == "tiny":
         # Correctness-mode size for CPU dry runs of the harness itself.
         model_config = GPTConfig(vocab_size=256, hidden_size=64,
@@ -348,6 +357,7 @@ def main() -> None:
         use_flash=bool(args.flash), remat=_remat(args),
         mesh_cfg=mesh_cfg, strategy=args.strategy,
         offload=args.offload, offload_dtype=args.offload_dtype,
+        num_experts=args.num_experts, moe_top_k=args.moe_top_k,
     )
     result = {
         "metric": "train_tokens_per_sec",

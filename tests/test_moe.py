@@ -153,6 +153,37 @@ class TestMoELayer:
             np.asarray(out).reshape(32, 32), want, atol=2e-5
         )
 
+    @pytest.mark.parametrize("topk", [1, 2])
+    def test_gather_dispatch_matches_einsum(self, topk):
+        """The round-4 gather/scatter dispatch vs the one-hot einsum path:
+        identical outputs, aux, and gradients (same kept token-choices,
+        same gates — only the data movement differs)."""
+        cfg_g = dataclasses.replace(MOE_TINY, moe_top_k=topk,
+                                    moe_dispatch="gather")
+        cfg_e = dataclasses.replace(cfg_g, moe_dispatch="einsum")
+        x = jax.random.normal(jax.random.PRNGKey(21), (2, 32, 32))
+        lg, le = MoEMLP(cfg_g), MoEMLP(cfg_e)
+        params = lg.init(jax.random.PRNGKey(0), x)["params"]
+        out_g, aux_g = lg.apply({"params": params}, x)
+        out_e, aux_e = le.apply({"params": params}, x)
+        np.testing.assert_allclose(np.asarray(out_g), np.asarray(out_e),
+                                   atol=2e-6, rtol=1e-5)
+        np.testing.assert_allclose(float(aux_g), float(aux_e), rtol=1e-6)
+
+        def loss(mod):
+            def f(p, xx):
+                o, a = mod.apply({"params": p}, xx)
+                return jnp.sum(o * o) + a
+            return f
+
+        gg = jax.grad(loss(lg))(params, x)
+        ge = jax.grad(loss(le))(params, x)
+        jax.tree_util.tree_map(
+            lambda a_, b_: np.testing.assert_allclose(
+                np.asarray(a_), np.asarray(b_), atol=3e-6, rtol=2e-5),
+            gg, ge,
+        )
+
     def test_gradients_flow_to_router_and_experts(self):
         x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, 32))
         layer = MoEMLP(MOE_TINY)
