@@ -185,6 +185,47 @@ class TestGPTForward:
         l2, _ = model_flash.apply({"params": params}, ids)
         np.testing.assert_allclose(l1, l2, atol=2e-4, rtol=2e-4)
 
+    def test_fused_projections_same_tree_loss_and_gradients(self):
+        # fused_projections concatenates the q/k/v (and gate/up) kernels
+        # into one matmul per group at apply time. The parameter tree must
+        # be identical either way (checkpoint + sharding-rule invariance),
+        # init must produce the same values (module paths unchanged), and
+        # loss/gradients must agree to dot-reassociation tolerance.
+        c_fused = tiny_config(fused_projections=True)
+        c_sep = tiny_config(fused_projections=False)
+        model_f, params, ids = init_model(c_fused)
+        model_s, params_s, _ = init_model(c_sep)
+        assert (jax.tree_util.tree_structure(params)
+                == jax.tree_util.tree_structure(params_s))
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(a, b),
+            params, params_s,
+        )
+
+        def loss_fn(model):
+            def f(p):
+                _, loss = model.apply({"params": p}, ids, labels=ids)
+                return loss
+            return f
+
+        l_f, g_f = jax.value_and_grad(loss_fn(model_f))(params)
+        l_s, g_s = jax.value_and_grad(loss_fn(model_s))(params)
+        np.testing.assert_allclose(l_f, l_s, rtol=1e-6, atol=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5),
+            g_f, g_s,
+        )
+
+    def test_fused_projections_gqa_parity(self):
+        # Under GQA the fused kernel is [H, H + 2*kv] with kv < H; the
+        # split boundaries must land exactly on the k/v sections.
+        c_fused = tiny_config(num_kv_heads=2)
+        c_sep = tiny_config(num_kv_heads=2, fused_projections=False)
+        model_f, params, ids = init_model(c_fused)
+        l_f, _ = model_f.apply({"params": params}, ids)
+        l_s, _ = GPT(c_sep).apply({"params": params}, ids)
+        np.testing.assert_allclose(l_f, l_s, rtol=2e-5, atol=2e-5)
+
     def test_gradient_checkpointing_same_forward(self):
         config = tiny_config()
         config_remat = tiny_config(gradient_checkpointing=True)

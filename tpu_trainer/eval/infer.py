@@ -81,6 +81,10 @@ def main(argv=None) -> int:
     # Sampling is deterministic-eval: no dropout.
     import dataclasses
     config = dataclasses.replace(config, dropout=0.0, attention_dropout=0.0)
+    if args.mesh_tensor > 1 and config.fused_projections:
+        # TP shards the q/k/v kernels along the axis the fusion
+        # concatenates (same gate as Trainer.__init__).
+        config = dataclasses.replace(config, fused_projections=False)
 
     tokenizer = get_tokenizer(args.tokenizer)
     if args.prompt_file:

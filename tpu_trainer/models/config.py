@@ -158,6 +158,16 @@ class GPTConfig:
     # the right choice for very deep models or fast iteration.
     scan_unroll: bool = True
 
+    # Fuse the q/k/v projections into one [H, H+2*kv] matmul and gate/up
+    # into one [H, 2I] matmul (models/gpt.py): the input activations are
+    # read from HBM once per fused group and the MXU sees one wide dot
+    # instead of two or three narrow ones. Parameters stay separate
+    # (checkpoint layout and name-based sharding rules unchanged — the
+    # concatenate is a compute-graph detail). The Trainer and the decode
+    # CLI force this off when the mesh's tensor axis > 1: TP shards those
+    # kernels along exactly the axis the fusion concatenates.
+    fused_projections: bool = True
+
     # Static switch for the ragged (per-row prompt length) KV-decode path:
     # set internally by generate_kv(prompt_lens=...); uniform decode keeps
     # the cheaper shared-position attention. Not a training knob.

@@ -74,6 +74,73 @@ from tpu_trainer.ops.rope import (  # noqa: E402,F401
 )
 
 
+class _ProjKernel(nn.Module):
+    """Bare projection weight with an ``nn.Dense``-identical parameter tree.
+
+    Creates ``<name>/kernel`` with the same shape, init, and param dtype as
+    the no-bias ``nn.Dense`` it stands in for, but returns the raw kernel so
+    the caller can fuse several projections into one matmul
+    (``GPTConfig.fused_projections``). Checkpoints, sharding rules
+    (``parallel/sharding.py`` suffix matching), and param counting are
+    unchanged either way.
+    """
+
+    features: int
+    param_dtype: jnp.dtype
+    kernel_init: nn.initializers.Initializer
+
+    @nn.compact
+    def __call__(self, in_features: int) -> jax.Array:
+        return self.param(
+            "kernel", self.kernel_init, (in_features, self.features),
+            self.param_dtype,
+        )
+
+
+def _use_fused_projections(cfg: GPTConfig) -> bool:
+    """Trace-time decision for ``cfg.fused_projections``.
+
+    TP shards the q/k/v (and gate/up) kernels along their output dim — the
+    axis the fusion concatenates — so fusing there would make GSPMD gather
+    the kernel shards every step. When a mesh context is published
+    (``parallel/context.py``), refuse to fuse over a >1 tensor axis even if
+    the config asks for it — this covers every Trainer path (the context
+    stays visible inside the pipeline's partial-manual stage body, whose
+    manual axes are only {stage, sequence}). The Trainer *also* flips the
+    config flag off under TP so the decision is visible in the stored
+    config; entry points that never publish a mesh (``eval/infer.py``)
+    rely on that config-level gate.
+    """
+    if not cfg.fused_projections:
+        return False
+    from tpu_trainer.parallel import context as ctx_lib
+
+    mesh = ctx_lib.current_mesh()
+    return mesh is None or mesh.shape.get("tensor", 1) <= 1
+
+
+def _fused_projection(cfg: GPTConfig, x: jax.Array, specs) -> list:
+    """Run several no-bias projections of ``x`` as ONE wide matmul.
+
+    ``specs`` is ``[(name, features), ...]``; the per-projection kernels are
+    created as separate parameters (``_ProjKernel``) and concatenated at
+    trace time, so x is read from HBM once and the MXU sees a single dot.
+    Returns the per-projection outputs (the split of the wide result).
+    Module creation happens against the caller's compact context, so the
+    parameter paths land under the calling module exactly as nn.Dense would.
+    """
+    kern = functools.partial(
+        _ProjKernel, param_dtype=cfg.params_dtype,
+        kernel_init=nn.initializers.normal(cfg.initializer_range),
+    )
+    in_f = x.shape[-1]
+    ws = [kern(features, name=name)(in_f) for name, features in specs]
+    w = jnp.concatenate(ws, axis=1)
+    out = x.astype(cfg.compute_dtype) @ w.astype(cfg.compute_dtype)
+    bounds = np.cumsum([features for _, features in specs])[:-1].tolist()
+    return jnp.split(out, bounds, axis=-1)
+
+
 class CausalSelfAttention(nn.Module):
     """Multi-head causal self-attention (reference ``gpt.py:150-242``).
 
@@ -100,9 +167,20 @@ class CausalSelfAttention(nn.Module):
             kernel_init=nn.initializers.normal(cfg.initializer_range),
         )
         kv_features = cfg.kv_heads * cfg.head_dim
-        q = dense(features=cfg.hidden_size, name="q_proj")(x)
-        k = dense(features=kv_features, name="k_proj")(x)
-        v = dense(features=kv_features, name="v_proj")(x)
+        if _use_fused_projections(cfg):
+            # One [H, H + 2*kv] matmul instead of three: x is read from HBM
+            # once and the MXU sees one wide dot; params stay separate
+            # (checkpoint + sharding-rule invariance — see
+            # _fused_projection / GPTConfig.fused_projections).
+            q, k, v = _fused_projection(
+                cfg, x,
+                [("q_proj", cfg.hidden_size), ("k_proj", kv_features),
+                 ("v_proj", kv_features)],
+            )
+        else:
+            q = dense(features=cfg.hidden_size, name="q_proj")(x)
+            k = dense(features=kv_features, name="k_proj")(x)
+            v = dense(features=kv_features, name="v_proj")(x)
 
         # [b, s, h*d] -> [b, s, heads, head_dim] (BSHD; no BHSD transpose on
         # TPU). Under GQA the k/v head dim is num_kv_heads (< num_heads).
@@ -289,8 +367,16 @@ class MLP(nn.Module):
             param_dtype=cfg.params_dtype,
             kernel_init=nn.initializers.normal(cfg.initializer_range),
         )
-        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
-        up = dense(cfg.intermediate_size, name="up_proj")(x)
+        if _use_fused_projections(cfg):
+            # gate+up as one [H, 2I] matmul (see _fused_projection).
+            gate, up = _fused_projection(
+                cfg, x,
+                [("gate_proj", cfg.intermediate_size),
+                 ("up_proj", cfg.intermediate_size)],
+            )
+        else:
+            gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+            up = dense(cfg.intermediate_size, name="up_proj")(x)
         act = {"silu": nn.silu, "gelu": nn.gelu}[cfg.activation]
         x = act(gate) * up
         x = dense(cfg.hidden_size, name="down_proj")(x)

@@ -31,6 +31,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # Auto chunking targets ~8k tokens per chunk (~1.6 GB of transient f32
 # logits at GPT-2 vocab): big chunks amortize the embedding-matrix reads and
@@ -171,7 +172,11 @@ _chunked_ce.defvjp(_ce_fwd, _ce_bwd)
 # makes one late psum equivalent to psumming here), and ``d e_slice`` is
 # slice-local.
 
-_NEG = jnp.float32(-1e30)  # -inf without the inf-inf => NaN hazard
+# -inf without the inf-inf => NaN hazard. A numpy scalar, NOT jnp: in
+# current JAX ``jnp.float32(...)`` builds a device array, which would
+# initialize the backend at import time and pin the platform before a CLI
+# ``--device cpu`` / test-harness ``jax.config.update`` can choose it.
+_NEG = np.float32(-1e30)
 
 
 def _vshard_cols(vs: int, vocab: int, axis_name: str):

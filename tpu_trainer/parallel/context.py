@@ -36,9 +36,11 @@ _ACTIVE: Optional[MeshContext] = None
 def mesh_scope(mesh: Optional[Mesh]):
     """While active (static, trace-time), mesh-aware ops may shard_map
     themselves over ``mesh`` instead of appearing opaque to GSPMD.
-    ``mesh_scope(None)`` masks an outer scope — used inside already-manual
-    regions (the pipeline stage body) where a nested kernel shard_map over
-    the same mesh would be invalid."""
+    ``mesh_scope(None)`` masks an outer scope if a region ever needs to
+    hide the mesh from nested ops (no current caller does: the pipeline
+    stage body is *partial*-manual over {stage, sequence} only, and ops
+    that must behave differently inside it key off
+    ``ring.current_manual_context()`` instead)."""
     global _ACTIVE
     prev = _ACTIVE
     _ACTIVE = MeshContext(mesh)

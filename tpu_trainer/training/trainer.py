@@ -207,6 +207,14 @@ class Trainer:
                     f"divisible by tensor axis size {self.tp_size} (each "
                     f"tensor shard must own whole K/V-head groups)"
                 )
+            # TP shards the q/k/v (and gate/up) kernels along their output
+            # dim — the axis fused_projections concatenates. Fusing there
+            # would force GSPMD to gather the shards; keep the narrow
+            # per-projection matmuls, which shard cleanly.
+            if self.model_config.fused_projections:
+                self.model_config = dataclasses.replace(
+                    self.model_config, fused_projections=False
+                )
         self.stage_size = self.mesh.shape.get(mesh_lib.STAGE_AXIS, 1)
         if self.stage_size > 1:
             # Pipeline parallelism (parallel/pipeline.py): contiguous layer
