@@ -123,6 +123,15 @@ class GPTConfig:
     fused_loss: bool = True
     # Sequence-chunk length for fused_loss; 0 = auto (~8k tokens per chunk).
     loss_chunk_size: int = 0
+    # On compiled TPU, compute the fused loss with the Pallas head kernel
+    # (ops/head_ce.py): the softmax statistics ride through the head matmul
+    # online (flash-attention-style), deleting the separate logsumexp HBM
+    # pass over the [tokens, vocab] block, and the backward reads saved
+    # compute-dtype logits instead of re-using the f32 block. Loss stays
+    # exact f32; backward probabilities carry bf16 rounding (same order as
+    # the flash kernel's backward). Falls back to the XLA blockwise path
+    # off-TPU and on meshes with sequence/stage/tensor/expert sharding.
+    fused_loss_pallas: bool = True
     # GPipe microbatch count when the mesh has a `stage` axis > 1
     # (parallel/pipeline.py); 0 = auto (one microbatch per stage). More
     # microbatches -> smaller pipeline bubble, smaller per-step matmuls.

@@ -593,7 +593,9 @@ class GPT(nn.Module):
                 # either pass (ops/loss.py; the `logits` above are dead code
                 # in the training graph, which only consumes the loss).
                 loss = fused_shifted_cross_entropy(
-                    embed.embedding, x, labels, chunk_size=cfg.loss_chunk_size
+                    embed.embedding, x, labels,
+                    chunk_size=cfg.loss_chunk_size,
+                    allow_pallas=cfg.fused_loss_pallas,
                 )
             elif cfg.remat_lm_head:
                 # Nothing of the [b, s, vocab] softmax survives forward; the

@@ -12,10 +12,28 @@ sequence is processed in chunks under a ``custom_vjp``; each chunk's logits
 live only transiently (a ``[batch, chunk, vocab]`` block), the forward saves
 just the per-token logsumexp (``[batch, seq]`` float32), and the backward
 recomputes each chunk's logits once to form ``dx`` and the embedding
-cotangent ``dE`` directly — full logits never exist in either pass.
-Measured: 4.4x faster than the materialized path at GPT-2-small geometry
-(83.8 ms -> 18.9 ms standalone fwd+bwd), bitwise-comparable gradients
-(max |Δ| ~6e-8 vs the jnp oracle).
+cotangent ``dE`` directly. Measured: 4.4x faster than the materialized path
+at GPT-2-small geometry (83.8 ms -> 18.9 ms standalone fwd+bwd),
+bitwise-comparable gradients (max |Δ| ~6e-8 vs the jnp oracle).
+
+Two compiled-reality notes (round-4 xplane traces at headline geometry,
+where ~8k tokens/step means ONE chunk):
+
+- At nchunks == 1 the trip-1 scan unrolls and one ``[tokens, vocab]`` f32
+  block DOES materialize transiently (1.54 GB at bs=8/seq=1024): the head
+  matmul is hidden behind its own compute (write bandwidth ~495 GB/s
+  against the 190 TFLOP/s dot), and XLA CSEs the backward body's
+  "recompute" against the still-live forward logits — the backward re-runs
+  nothing. An explicit save-compute-dtype-logits residual was measured
+  2.3% SLOWER end-to-end than trusting this CSE (it adds a bf16 copy the
+  compiler otherwise never builds). At nchunks > 1 (large global batches)
+  the scans stay rolled, blocks stay ``[batch, chunk, vocab]``, and the
+  backward genuinely recomputes — the memory-bound regime this blockwise
+  design exists for.
+- The remaining separable cost is the logsumexp pass re-reading the f32
+  block (~2.2 ms at headline geometry, pure HBM) — the target of the
+  Pallas fused head kernel (``ops/head_ce.py``) which carries the softmax
+  statistics through the matmul online, flash-attention-style.
 
 Chunking runs over the *sequence* dim so every operation keeps the batch dim
 leading: under DP/FSDP meshes (batch sharded over ``data × fsdp``) each chunk
@@ -356,25 +374,60 @@ def vocab_sharded_shifted_cross_entropy(
                               vocab, seq_axis)
 
 
+def _pallas_head_ok(x: jax.Array, chunk_size: int) -> bool:
+    """Route to the Pallas fused head kernel (``ops/head_ce.py``)?
+
+    Compiled-TPU + bf16 compute + enough tokens to amortize the grid (but
+    few enough that the kernel's ``[V, T]`` compute-dtype saved-logits
+    residual stays moderate — it is NOT chunked, so past ~16k tokens the
+    memory-bounding blockwise path wins), on a mesh whose only sharded
+    axes are batch ones (data/fsdp — the kernel shard_maps over those).
+    An explicit ``loss_chunk_size`` is a memory-bounding request and
+    always keeps the chunked XLA path. Sequence sharding changes the
+    shift semantics, a stage axis means the pipeline owns the head, and
+    TP shards the embedding's hidden dim, all of which also keep the XLA
+    blockwise path.
+    """
+    b, s, _ = x.shape
+    if chunk_size > 0:
+        return False
+    if x.dtype != jnp.bfloat16 or not 2048 <= b * s <= 16384:
+        return False
+    if not any(d.platform == "tpu" for d in jax.devices()):
+        return False
+    from tpu_trainer.parallel.context import current_mesh
+
+    mesh = current_mesh()
+    if mesh is not None:
+        for axis in ("sequence", "stage", "tensor", "expert"):
+            if mesh.shape.get(axis, 1) > 1:
+                return False
+    return True
+
+
 def fused_shifted_cross_entropy(
     emb: jax.Array,
     x: jax.Array,
     labels: jax.Array,
     *,
     chunk_size: int = 0,
+    allow_pallas: bool = True,
 ) -> jax.Array:
     """Mean next-token cross entropy of the tied LM head, logits-free.
 
     Semantically identical to
     ``mean(softmax_xent(x @ emb.T [:, :-1], labels[:, 1:]))`` — the
     reference's shifted loss (``gpt.py:450-453``) — but computed blockwise
-    (see module docstring).
+    (see module docstring), or by the Pallas fused head kernel
+    (``ops/head_ce.py``) on compiled TPU where eligible.
 
     Args:
       emb: tied embedding matrix ``[vocab, hidden]`` (the LM head weight).
       x: final hidden states ``[batch, seq, hidden]`` (post final-norm).
       labels: token ids ``[batch, seq]`` (unshifted; shift happens here).
       chunk_size: sequence-chunk length; 0 = auto (~8k tokens per chunk).
+      allow_pallas: permit the Pallas kernel when eligible
+        (``GPTConfig.fused_loss_pallas``).
 
     Returns: scalar float32 loss, averaged over ``batch * (seq - 1)``.
     """
@@ -384,5 +437,10 @@ def fused_shifted_cross_entropy(
     )
     pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
     mask = (pos < s - 1).astype(jnp.float32)
+    if allow_pallas and _pallas_head_ok(x, chunk_size):
+        from tpu_trainer.ops.head_ce import pallas_head_ce
+        from tpu_trainer.parallel.context import current_mesh
+
+        return pallas_head_ce(emb, x, shifted, mask, current_mesh(), False)
     chunk = _chunk_len(b, s, chunk_size)
     return _chunked_ce(emb, x, shifted, mask, chunk)

@@ -162,6 +162,35 @@ def _kernel_checks():
         q, krep, vrep))
     check("GQA vs repeated-KV oracle", np.allclose(got, want, atol=2e-2))
 
+    # 8. Pallas fused head+CE (ops/head_ce.py) vs the XLA blockwise loss —
+    # compiled path at headline-like shapes (incl. the ragged vocab edge).
+    from tpu_trainer.ops.head_ce import pallas_head_ce
+    from tpu_trainer.ops.loss import _chunk_len, _chunked_ce
+
+    bh, sh, hh, V = 4, 1024, 256, 50257
+    kk = jax.random.split(jax.random.PRNGKey(21), 3)
+    embw = jax.random.normal(kk[0], (V, hh), jnp.float32) * 0.02
+    xh = jax.random.normal(kk[1], (bh, sh, hh), jnp.bfloat16)
+    labs = jax.random.randint(kk[2], (bh, sh), 0, V)
+    maskh = (jax.lax.broadcasted_iota(jnp.int32, (bh, sh), 1)
+             < sh - 1).astype(jnp.float32)
+
+    def _o(e_, x_):
+        return _chunked_ce(e_, x_, labs, maskh, _chunk_len(bh, sh, 0))
+
+    def _p(e_, x_):
+        return pallas_head_ce(e_, x_, labs, maskh, None, False)
+
+    (lo, go) = jax.jit(jax.value_and_grad(_o, argnums=(0, 1)))(embw, xh)
+    (lp, gp) = jax.jit(jax.value_and_grad(_p, argnums=(0, 1)))(embw, xh)
+    dl = abs(float(lo) - float(lp))
+    de = float(jnp.max(jnp.abs(go[0] - gp[0])))
+    dx = float(jnp.max(jnp.abs(go[1].astype(jnp.float32)
+                               - gp[1].astype(jnp.float32))))
+    check("fused head+CE kernel vs XLA loss",
+          dl < 1e-4 and de < 1e-4 and dx < 1e-4,
+          f"dloss={dl:.1e} dE={de:.1e} dx={dx:.1e}")
+
 
 def _tiny_trainer(offload=False, offload_dtype="float32",
                   mixed_precision="fp32", flash=False, mesh_kw=None,
