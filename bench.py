@@ -72,6 +72,10 @@ def _build_parser():
                    help="MoE: routed experts per FFN (0 = dense); MFU is "
                         "reported against ACTIVE params")
     p.add_argument("--moe-top-k", type=int, default=1)
+    p.add_argument("--carry-cast", type=int,
+                   default=int(env("BENCH_CARRY_CAST", "1")),
+                   help="TrainingConfig.carry_cast_params (0 to free the "
+                        "compute-dtype param copy on HBM-edge configs)")
     p.add_argument("--model-flag", action="append", default=[],
                    metavar="KEY=VALUE",
                    help="override a GPTConfig field (repeatable), e.g. "
@@ -125,7 +129,7 @@ def _parse_model_flags(pairs):
 def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
               remat, mesh_cfg, strategy, devices=None, offload=False,
               offload_dtype="float32", num_experts=0, moe_top_k=1,
-              model_flags=None):
+              model_flags=None, carry_cast=True):
     """One measured config -> result dict. ``batch_size`` is per data shard
     (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
@@ -168,6 +172,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         gradient_accumulation_steps=accum,
         mixed_precision="bf16",
         log_interval=10**9,
+        carry_cast_params=carry_cast,
     )
     trainer = Trainer(model_config, training_config,
                       ParallelConfig(mesh_cfg, strategy or "replicated",
@@ -408,6 +413,7 @@ def main() -> None:
         offload=args.offload, offload_dtype=args.offload_dtype,
         num_experts=args.num_experts, moe_top_k=args.moe_top_k,
         model_flags=_parse_model_flags(args.model_flag),
+        carry_cast=bool(args.carry_cast),
     )
     result = {
         "metric": "train_tokens_per_sec",

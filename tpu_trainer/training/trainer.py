@@ -53,6 +53,38 @@ _MP_TO_DTYPE = {"fp32": "float32", "bf16": "bfloat16", "fp16": "float16"}
 _QUANT_BLOCK = 256  # target block length for int8 offload quantization
 
 
+@jax.custom_vjp
+def _linked_cast(master, casted):
+    """Use a precomputed compute-dtype param copy, gradients to the master.
+
+    Forward returns ``casted`` (== the compute-dtype cast of ``master``,
+    produced inside the PREVIOUS step's optimizer-update fusion — see
+    ``TrainState.params_c``); the backward converts the cotangents to the
+    master's f32, which is exactly the transpose of the cast this replaces,
+    so XLA fuses it into the dW producers the same way it fused the
+    original cast-transpose. Numerics are identical to casting ``master``
+    in-place.
+    """
+    return casted
+
+
+def _linked_cast_fwd(master, casted):
+    return casted, None
+
+
+def _linked_cast_bwd(_, g):
+    # master's cotangent: the cast-transpose (convert to f32). casted is a
+    # derived constant at every call site; its zero cotangent is dead code
+    # the compiler drops.
+    return (
+        jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), g),
+        jax.tree_util.tree_map(jnp.zeros_like, g),
+    )
+
+
+_linked_cast.defvjp(_linked_cast_fwd, _linked_cast_bwd)
+
+
 def _quant_block_len(d: int) -> int:
     """Largest of {256, 128, 64, 32} dividing ``d`` (else ``d`` itself —
     one block per row)."""
@@ -105,6 +137,17 @@ class TrainState(struct.PyTreeNode):
     rng: jax.Array             # dropout PRNG key chain
     loss_scale: jax.Array      # float32 scalar (fp16 dynamic scaling; 1.0 else)
     good_steps: jax.Array      # int32: consecutive finite-grad steps (fp16)
+    # Compute-dtype copy of the >=2-D params (None when inactive). The
+    # f32->bf16 cast of the full parameter tree used to run as separate
+    # convert passes at the top of every step (~1.7 ms at headline
+    # geometry: the cast lives in the NEXT step's executable, so XLA
+    # cannot fuse it into the optimizer-update fusions that produced the
+    # params). Carrying the cast in the state moves it into the update
+    # fusion's epilogue. Derived data: excluded from checkpoints
+    # (utils/checkpoint.py strips it on save and rebuilds on restore), so
+    # the checkpoint format is unchanged and pre-round-4 checkpoints
+    # restore cleanly.
+    params_c: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -260,6 +303,22 @@ class Trainer:
         self.model = GPT(self.model_config)
         self.optimizer = make_optimizer(training_config)
 
+        # Carry the compute-dtype param copy in the state (see
+        # TrainState.params_c / TrainingConfig.carry_cast_params): only
+        # meaningful when compute and param dtypes differ, and skipped
+        # under cpu_offload — those configs run at the HBM edge and the
+        # extra copy is the marginal GB while the stream dwarfs the cast.
+        self._carry_cast = (
+            training_config.carry_cast_params
+            and self.model_config.compute_dtype
+            != self.model_config.params_dtype
+            and not parallel_config.cpu_offload
+            # The pipeline's manual schedules take the f32 master and
+            # manage their own stage-local casts; keep their param flow
+            # unchanged.
+            and self.stage_size == 1
+        )
+
         # cpu_offload viability + host storage dtype must be known before
         # state shapes are traced (_make_state casts the stored state).
         self.cpu_offload = parallel_config.cpu_offload
@@ -303,15 +362,21 @@ class Trainer:
             jax.eval_shape(self.optimizer.init, state_shapes.params),
         )
         replicated = P()
+        param_specs = shard_lib.params_specs(
+            state_shapes.params, self.mesh, self.strategy
+        )
         self._state_specs = TrainState(
             step=replicated,
-            params=shard_lib.params_specs(state_shapes.params, self.mesh, self.strategy),
+            params=param_specs,
             opt_state=shard_lib.opt_state_specs(
                 state_shapes.opt_state, self.mesh, self.strategy
             ),
             rng=replicated,
             loss_scale=replicated,
             good_steps=replicated,
+            # params_c mirrors the params' placement leaf for leaf (same
+            # tree, same shapes, compute dtype).
+            params_c=param_specs if self._carry_cast else None,
         )
         self.state_shardings = shard_lib.to_shardings(self._state_specs, self.mesh)
         self._grad_shardings = shard_lib.to_shardings(
@@ -464,6 +529,23 @@ class Trainer:
             opt_state, self._opt_compute_dtypes,
         )
 
+    def _cast_params(self, params):
+        """Compute-dtype copy of the >=2-D param leaves (exactly the cast
+        the modules apply: Dense/Embed promote their matrices to the
+        module dtype; 1-D leaves — RMSNorm weights — stay f32)."""
+        cd = self.model_config.compute_dtype
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(cd) if p.ndim >= 2 else p, params
+        )
+
+    def _apply_params(self, state: TrainState):
+        """The param tree the model forward should consume: the carried
+        compute-dtype copy (gradients linked to the f32 master via
+        ``_linked_cast``), or the master itself when the carry is off."""
+        if state.params_c is None:
+            return state.params
+        return _linked_cast(state.params, state.params_c)
+
     def _make_state(self, rng: jax.Array) -> TrainState:
         param_rng, dropout_rng = jax.random.split(rng)
         dummy = jnp.zeros((1, 8), jnp.int32)
@@ -477,7 +559,21 @@ class Trainer:
             rng=dropout_rng,
             loss_scale=jnp.asarray(init_scale, jnp.float32),
             good_steps=jnp.zeros((), jnp.int32),
+            params_c=self._cast_params(params) if self._carry_cast else None,
         )
+
+    def with_params_c(self, state: TrainState) -> TrainState:
+        """Attach the derived compute-dtype param copy to a state that lacks
+        it (checkpoint restore: ``params_c`` is stripped on save)."""
+        if not self._carry_cast or state.params_c is not None:
+            return state
+        cast = jax.jit(
+            self._cast_params,
+            out_shardings=shard_lib.to_shardings(
+                self._state_specs.params_c, self.mesh
+            ),
+        )
+        return state.replace(params_c=cast(state.params))
 
     def init_state(self, seed: Optional[int] = None) -> TrainState:
         """Initialize (sharded directly on the mesh — params never exist
@@ -612,6 +708,12 @@ class Trainer:
         assert batch.ndim == 3 and batch.shape[0] == accum
 
         def loss_fn(params, micro, rng, scale):
+            # With the carried cast, the forward consumes the state's
+            # compute-dtype copy; gradients still land on the f32 master
+            # (_linked_cast routes the cotangents through the
+            # cast-transpose). Identical numerics to casting here.
+            if state.params_c is not None:
+                params = _linked_cast(params, state.params_c)
             with self._sp_context():
                 _, loss = self.model.apply(
                     {"params": params},
@@ -692,12 +794,19 @@ class Trainer:
             if self.cpu_offload:
                 new_opt = jax.device_put(new_opt, self._opt_host_shardings)
             updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
-            return optax.apply_updates(state.params, updates), new_opt
+            new_p = optax.apply_updates(state.params, updates)
+            # The compute-dtype copy is produced HERE, in the same
+            # executable as the update — XLA fuses the cast into the
+            # update fusions' epilogues (the point of params_c).
+            new_c = self._cast_params(new_p) if self._carry_cast else None
+            return new_p, new_opt, new_c
 
         if self.use_loss_scaling:
             finite = jnp.isfinite(grad_norm)
-            new_params, new_opt = jax.lax.cond(
-                finite, apply_update, lambda _: (state.params, state.opt_state), None
+            new_params, new_opt, new_params_c = jax.lax.cond(
+                finite, apply_update,
+                lambda _: (state.params, state.opt_state, state.params_c),
+                None,
             )
             grew = state.good_steps + 1 >= _SCALE_GROWTH_INTERVAL
             new_scale = jnp.where(
@@ -708,7 +817,7 @@ class Trainer:
             )
             new_good = jnp.where(finite, jnp.where(grew, 0, state.good_steps + 1), 0)
         else:
-            new_params, new_opt = apply_update(None)
+            new_params, new_opt, new_params_c = apply_update(None)
             new_scale, new_good = state.loss_scale, state.good_steps
 
         metrics = {
@@ -722,6 +831,7 @@ class Trainer:
             params=new_params,
             opt_state=new_opt,
             rng=new_rng,
+            params_c=new_params_c,
         )
         if self.use_loss_scaling:
             new_state = new_state.replace(loss_scale=new_scale, good_steps=new_good)

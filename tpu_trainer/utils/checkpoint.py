@@ -74,6 +74,11 @@ def save_checkpoint(
     analogue of the reference's save-then-barrier (``fsdp_trainer.py:465``).
     """
     path = step_dir(checkpoint_dir, int(state.step))
+    if getattr(state, "params_c", None) is not None:
+        # Derived data (the compute-dtype param copy): stripping it keeps
+        # the on-disk format identical to pre-carry checkpoints and saves
+        # the copy's bytes; restore_checkpoint rebuilds it.
+        state = state.replace(params_c=None)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(path, "state"), state, force=True)
     ckptr.wait_until_finished()
@@ -148,13 +153,19 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
                 f"point --checkpoint_dir at a fresh directory, pass "
                 f"--no_auto_resume to start over, or match the saved config"
             )
+    # Checkpoints never hold params_c (stripped on save — derived data);
+    # restore against the stripped structure, then rebuild the copy.
+    shapes = shapes.replace(params_c=None)
+    shardings = trainer.state_shardings
+    if getattr(shardings, "params_c", None) is not None:
+        shardings = shardings.replace(params_c=None)
     abstract = jax.tree_util.tree_map(
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         shapes,
-        trainer.state_shardings,
+        shardings,
     )
     state = ocp.StandardCheckpointer().restore(os.path.join(path, "state"), abstract)
-    return state, meta
+    return trainer.with_params_c(state), meta
 
 
 def restore_params(path: str):
