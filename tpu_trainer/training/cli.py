@@ -52,6 +52,12 @@ _SHARDING_CHOICES = [
     "zero3", "zero2", "replicated", "ddp",
 ]
 
+# Host-offload storage dtypes the trainer implements (trainer.py:344-354).
+# Shared between the argparse choices and the YAML validation below — any
+# other string would flow into jnp.dtype() as a silently-corrupting storage
+# cast (e.g. int16 truncates Adam moments to zero).
+_OFFLOAD_DTYPES = ["float32", "bfloat16", "int8"]
+
 
 def build_parser(mode: str) -> argparse.ArgumentParser:
     """Argument parser; defaults are ``None`` sentinels so that explicit CLI
@@ -153,9 +159,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                        choices=_SHARDING_CHOICES)
         p.add_argument("--cpu_offload", action="store_true", default=None)
         p.add_argument("--offload_dtype", default=None,
-                       choices=["float32", "bfloat16"],
+                       choices=_OFFLOAD_DTYPES,
                        help="host storage dtype for offloaded optimizer "
-                            "state; bfloat16 halves the host-link stream")
+                            "state; bfloat16 halves the host-link stream, "
+                            "int8 (blockwise-absmax moments) quarters it")
         p.add_argument("--no_activation_checkpointing", action="store_true",
                        default=None)
     return p
@@ -305,6 +312,11 @@ def resolve_configs(args, mode: str):
         )
         offload_dtype = _pick(getattr(args, "offload_dtype", None),
                               y_fsdp.get("offload_dtype"), "float32")
+        if offload_dtype not in _OFFLOAD_DTYPES:
+            raise SystemExit(
+                f"offload_dtype {offload_dtype!r} not supported; choose "
+                f"one of {_OFFLOAD_DTYPES}"
+            )
         default_mesh = mesh_lib.MeshConfig(data=1, fsdp=-1)
     else:
         strategy = "replicated"
