@@ -68,6 +68,9 @@ def _build_parser():
                    help="host-offload optimizer state (pinned_host stream)")
     p.add_argument("--offload-dtype", default="float32",
                    help="offloaded-state storage: float32 | bfloat16 | int8")
+    p.add_argument("--opt-state-dtype", default="float32",
+                   help="on-device Adam moment storage: float32 | bfloat16 "
+                        "| int8 (TrainingConfig.optimizer_state_dtype)")
     p.add_argument("--num-experts", type=int, default=0,
                    help="MoE: routed experts per FFN (0 = dense); MFU is "
                         "reported against ACTIVE params")
@@ -129,7 +132,8 @@ def _parse_model_flags(pairs):
 def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
               remat, mesh_cfg, strategy, devices=None, offload=False,
               offload_dtype="float32", num_experts=0, moe_top_k=1,
-              model_flags=None, carry_cast=True):
+              model_flags=None, carry_cast=True,
+              opt_state_dtype="float32"):
     """One measured config -> result dict. ``batch_size`` is per data shard
     (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
@@ -173,6 +177,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         mixed_precision="bf16",
         log_interval=10**9,
         carry_cast_params=carry_cast,
+        optimizer_state_dtype=opt_state_dtype,
     )
     trainer = Trainer(model_config, training_config,
                       ParallelConfig(mesh_cfg, strategy or "replicated",
@@ -247,6 +252,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         "mesh": dict(mesh.shape),
         "strategy": strategy or "replicated",
         "offload": bool(trainer.cpu_offload),
+        "opt_state_dtype": opt_state_dtype,
         "offload_dtype": offload_dtype if trainer.cpu_offload else None,
         "elapsed_s": round(elapsed, 3),
         "tok_per_sec": round(tok_per_sec, 1),
@@ -414,6 +420,7 @@ def main() -> None:
         num_experts=args.num_experts, moe_top_k=args.moe_top_k,
         model_flags=_parse_model_flags(args.model_flag),
         carry_cast=bool(args.carry_cast),
+        opt_state_dtype=args.opt_state_dtype,
     )
     result = {
         "metric": "train_tokens_per_sec",

@@ -117,6 +117,18 @@ class TestConfigResolution:
         _, _, parallel, _ = resolve_configs(args, "fsdp")
         assert parallel.cpu_offload and parallel.offload_dtype == "int8"
 
+    def test_optimizer_state_dtype_reaches_training_config(self, tiny_yaml):
+        for dt in ("float32", "bfloat16", "int8"):
+            args = build_parser("ddp").parse_args(
+                ["--config", tiny_yaml, "--optimizer_state_dtype", dt]
+            )
+            _, train, _, _ = resolve_configs(args, "ddp")
+            assert train.optimizer_state_dtype == dt
+        # YAML spelling (training: section)
+        args = build_parser("ddp").parse_args(["--config", tiny_yaml])
+        _, train, _, _ = resolve_configs(args, "ddp")
+        assert train.optimizer_state_dtype == "float32"  # default
+
     def test_offload_dtype_yaml_rejects_unknown(self, tmp_path):
         # The YAML path must enforce the same choice list as argparse:
         # an unknown dtype (int16) would flow into jnp.dtype() as a

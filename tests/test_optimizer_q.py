@@ -1,0 +1,150 @@
+"""Quantized on-device Adam state (``optimizer_state_dtype``).
+
+Pins three contracts of ``scale_by_adam_quantized``:
+
+- small leaves stay exact f32, so the full chain is BITWISE optax.adamw
+  for a model whose leaves are all below the quantization threshold;
+- narrow-state training tracks exact-f32 training within a small loss
+  tolerance over tens of steps (the 8-bit-optimizer claim, tested the way
+  the int8 offload state is — tests/test_offload.py);
+- the state roundtrips through the checkpoint path (the packed moments
+  are a plain dict-of-arrays pytree).
+
+No reference counterpart: the reference has fp32 torch.optim.AdamW only
+(``ddp_trainer.py:174-234``).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.optimizer import (
+    _QUANT_MIN_SIZE,
+    make_optimizer,
+    scale_by_adam_quantized,
+)
+
+
+def _tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class TestScaleByAdamQuantized:
+    def test_small_leaves_bitwise_match_optax(self):
+        # Every leaf below _QUANT_MIN_SIZE -> the quantized chain must be
+        # bitwise optax.adamw(lr=1.0) step for step.
+        import optax
+
+        key = jax.random.PRNGKey(0)
+        params = {
+            "w": jax.random.normal(key, (16, 8), jnp.float32),
+            "b": jnp.zeros((8,), jnp.float32),
+        }
+        assert all(p.size < _QUANT_MIN_SIZE
+                   for p in jax.tree_util.tree_leaves(params))
+        cfg = TrainingConfig(optimizer_state_dtype="int8")
+        tx_q = make_optimizer(cfg)
+        tx_f = make_optimizer(dataclasses.replace(
+            cfg, optimizer_state_dtype="float32"))
+        sq, sf = tx_q.init(params), tx_f.init(params)
+        for i in range(5):
+            g = _tree_map(
+                lambda p: jax.random.normal(
+                    jax.random.fold_in(key, i), p.shape), params)
+            uq, sq = tx_q.update(g, sq, params)
+            uf, sf = tx_f.update(g, sf, params)
+            for a, b in zip(jax.tree_util.tree_leaves(uq),
+                            jax.tree_util.tree_leaves(uf)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            params = _tree_map(lambda p, u: p + u, params, uq)
+
+    @pytest.mark.parametrize("state_dtype", ["bfloat16", "int8"])
+    def test_large_leaf_tracks_f32_adam(self, state_dtype):
+        import optax
+
+        key = jax.random.PRNGKey(1)
+        w = jax.random.normal(key, (512, 256), jnp.float32)  # > threshold
+        params = {"w": w}
+        tx_q = scale_by_adam_quantized(0.9, 0.95, 1e-8, state_dtype)
+        tx_f = optax.scale_by_adam(b1=0.9, b2=0.95, eps=1e-8)
+        sq, sf = tx_q.init(params), tx_f.init(params)
+        pq = pf = params
+        for i in range(20):
+            g = {"w": 0.01 * jax.random.normal(
+                jax.random.fold_in(key, i), w.shape)}
+            uq, sq = tx_q.update(g, sq, pq)
+            uf, sf = tx_f.update(g, sf, pf)
+            pq = _tree_map(lambda p, u: p - 1e-3 * u, pq, uq)
+            pf = _tree_map(lambda p, u: p - 1e-3 * u, pf, uf)
+        # Narrow moments drift, but the trajectories stay close relative
+        # to how far the params moved.
+        moved = float(jnp.linalg.norm(pf["w"] - params["w"]))
+        drift = float(jnp.linalg.norm(pq["w"] - pf["w"]))
+        assert moved > 0
+        assert drift < 0.05 * moved, (drift, moved)
+
+    def test_quantized_state_is_checkpointable_pytree(self):
+        params = {"w": jnp.zeros((512, 256), jnp.float32)}
+        tx = scale_by_adam_quantized(0.9, 0.95, 1e-8, "int8")
+        s = tx.init(params)
+        leaves = jax.tree_util.tree_leaves(s)
+        assert all(isinstance(x, jax.Array) for x in leaves)
+        assert any(x.dtype == jnp.int8 for x in leaves)
+        flat, treedef = jax.tree_util.tree_flatten(s)
+        rebuilt = jax.tree_util.tree_unflatten(treedef, flat)
+        u, s2 = tx.update(
+            {"w": jnp.ones((512, 256), jnp.float32)}, rebuilt, params)
+        assert u["w"].shape == (512, 256)
+
+    def test_bad_dtype_rejected(self):
+        with pytest.raises(ValueError, match="optimizer_state_dtype"):
+            make_optimizer(TrainingConfig(optimizer_state_dtype="int16"))
+
+
+class TestTrainerIntegration:
+    @pytest.mark.parametrize("state_dtype", ["int8"])
+    def test_tiny_training_tracks_f32(self, state_dtype):
+        # End-to-end: the Trainer's jitted step with quantized moments
+        # follows the exact-f32 loss curve on a tiny model. Uses a hidden
+        # size large enough that the embedding crosses the quantization
+        # threshold (vocab 512 x hidden 128 = 64k).
+        from tpu_trainer.data.dummy import create_dummy_dataloader
+        from tpu_trainer.models.config import GPTConfig
+        from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+        from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+        model_cfg = GPTConfig(
+            vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+            intermediate_size=256, max_seq_len=64, dropout=0.0,
+            attention_dropout=0.0, use_flash_attention=False,
+        )
+        losses = {}
+        for dt in ("float32", state_dtype):
+            mesh = make_mesh(MeshConfig(data=1, fsdp=1),
+                             devices=jax.devices()[:1])
+            trainer = Trainer(
+                model_cfg,
+                TrainingConfig(batch_size=4, max_seq_len=64,
+                               gradient_accumulation_steps=1,
+                               mixed_precision="fp32", log_interval=10**9,
+                               optimizer_state_dtype=dt,
+                               learning_rate=1e-3, warmup_steps=1),
+                ParallelConfig(MeshConfig(data=1, fsdp=1), "replicated"),
+                mesh=mesh,
+            )
+            loader = create_dummy_dataloader(
+                batch_size=4, seq_len=64, vocab_size=512, num_batches=1)
+            batch = next(iter(loader))  # one fixed batch: memorizable
+            state = trainer.init_state()
+            curve = []
+            for _ in range(14):
+                state, metrics = trainer.train_step(state, batch)
+                curve.append(float(metrics["loss"]))
+            losses[dt] = curve
+        f32, q = np.array(losses["float32"]), np.array(losses[state_dtype])
+        assert f32[-1] < f32[0]  # it actually trains
+        np.testing.assert_allclose(q, f32, rtol=0.02, atol=0.02)

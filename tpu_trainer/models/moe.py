@@ -51,6 +51,111 @@ import jax.numpy as jnp
 from tpu_trainer.models.config import GPTConfig
 
 
+# --- all-gather dispatch/combine (custom_vjp) ------------------------------
+#
+# The dispatch map is a BIJECTION between kept token-choices (t, j) and
+# expert slots s (dropped choices hit a trailing trash slot / trash row on
+# either side). AD's transpose of a row gather is a row scatter-add, and on
+# v5e those scatter-adds measured ~45 GB/s (row-serial read-modify-write at
+# sub-sublane granularity) against ~420 GB/s for the matching gathers —
+# 14.1 ms of the 179 ms top-2 step (round-5 xplane). The bijection lets
+# every transpose be re-expressed as a gather through the INVERSE map, so
+# both passes of both movements run at gather speed:
+#
+#   dispatch fwd:  expert_in[s] = x[token(s)]                 (gather)
+#   dispatch bwd:  dx[t]        = sum_j d_ein[slot(t, j)]     (k gathers)
+#   combine  fwd:  out[t]       = sum_j g[t,j] * eo[slot(t,j)] (k gathers)
+#   combine  bwd:  d_eo[s]      = g[tc(s)] * dout[token(s)]    (one gather)
+#                  d_g[t,j]     = <dout[t], eo[slot(t,j)]>     (k gathers)
+#
+# ``slot_token`` maps slot -> source token (trash slots -> T, the zero pad
+# row); ``flat_ids`` maps (t, j) -> slot (dropped -> S, the zero pad row);
+# ``slot_tc`` maps slot -> flat token-choice in CHOICE-MAJOR order
+# (j*T + t; trash -> k*T). Choice-major is load-bearing twice: the k
+# per-choice gathers address clean [T, H] panels (token-major produced a
+# [T, k, H] intermediate whose T(2,128) tile layout cost ~2 ms/step of
+# relayout), and the combine backward's gate-scaled rows concatenate as
+# ``[dout * g_0; dout * g_1; ...]`` — a [k*T, H] buffer in natural layout,
+# so d_eo is ONE row gather instead of a row gather times a 1-D gate
+# gather (1-D gathers run element-serial on TPU; measured ~1 ms/step).
+
+
+@jax.custom_vjp
+def _dispatch_rows(x, slot_token, flat_ids):
+    """Gather token rows into expert slots: ``x [T, H] -> [S, H]``."""
+    x_pad = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)], axis=0)
+    return x_pad[slot_token]
+
+
+def _dispatch_rows_fwd(x, slot_token, flat_ids):
+    return _dispatch_rows(x, slot_token, flat_ids), flat_ids
+
+
+def _dispatch_rows_bwd(flat_ids, d_ein):
+    d_pad = jnp.concatenate(
+        [d_ein, jnp.zeros((1, d_ein.shape[1]), d_ein.dtype)], axis=0
+    )
+    dx = d_pad[flat_ids[:, 0]]
+    for j in range(1, flat_ids.shape[1]):
+        dx = dx + d_pad[flat_ids[:, j]]
+    return dx, None, None
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(eo, gates, flat_ids, slot_tc):
+    """Weighted gather-back: ``out[t] = sum_j gates[t, j] * eo[slot(t, j)]``.
+
+    ``eo [S, H]`` expert outputs, ``gates [T, k]`` f32, ``slot_tc [S]`` the
+    inverse map in CHOICE-MAJOR order (slot -> j*T + t; trash -> k*T) used
+    only by the backward.
+    """
+    eo_pad = jnp.concatenate(
+        [eo, jnp.zeros((1, eo.shape[1]), eo.dtype)], axis=0
+    )
+    out = None
+    for j in range(flat_ids.shape[1]):
+        contrib = eo_pad[flat_ids[:, j]] * gates[:, j:j + 1].astype(eo.dtype)
+        out = contrib if out is None else out + contrib
+    return out
+
+
+def _combine_rows_fwd(eo, gates, flat_ids, slot_tc):
+    return _combine_rows(eo, gates, flat_ids, slot_tc), (
+        eo, gates, flat_ids, slot_tc
+    )
+
+
+def _combine_rows_bwd(res, dout):
+    eo, gates, flat_ids, slot_tc = res
+    T, k = flat_ids.shape
+    H = eo.shape[1]
+    # Pre-scale dout by each choice's gate and stack choice-major: row
+    # j*T + t = dout[t] * gates[t, j]. One clean-layout buffer, one row
+    # gather through the inverse map; the trailing zero row absorbs trash
+    # slots (slot_tc = k*T).
+    dout_scaled = jnp.concatenate(
+        [dout * gates[:, j:j + 1].astype(dout.dtype) for j in range(k)]
+        + [jnp.zeros((1, H), dout.dtype)],
+        axis=0,
+    )
+    d_eo = dout_scaled[slot_tc]
+    eo_pad = jnp.concatenate(
+        [eo, jnp.zeros((1, H), eo.dtype)], axis=0
+    )
+    d_gates = jnp.stack(
+        [jnp.sum((eo_pad[flat_ids[:, j]] * dout).astype(jnp.float32), axis=-1)
+         for j in range(k)],
+        axis=1,
+    ).astype(gates.dtype)
+    return d_eo, d_gates, None, None
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
+
+
 class MoEMLP(nn.Module):
     """Top-k routed expert SwiGLU (replaces ``MLP`` when experts are on)."""
 
@@ -122,31 +227,33 @@ class MoEMLP(nn.Module):
             ep = mesh.shape.get("expert", 1) if mesh is not None else 1
             mode = "einsum" if ep > 1 else "gather"
         if mode == "gather":
-            # Gather/scatter dispatch (round 4, measured): the one-hot
+            # Gather dispatch (round 4, re-formulated round 5): the one-hot
             # dispatch/combine einsums cost 2*T*E*C*H FLOPs EACH — at
             # E=8/capacity 1.25 that is ~129 GF per einsum per layer vs
             # ~145 GF for all three expert FFN einsums combined, and the
             # [T, k, E, C] slot tensor is a ~335 MB f32 buffer. Measured
             # on v5e (xplane): dispatch/combine = 47.4 ms of a 156 ms
-            # step (30%). Here each expert slot instead GATHERS its
-            # token's row (index arithmetic is O(T*k) integers) and each
-            # token gathers its k expert outputs back; the only
-            # non-elementwise work is the two [E*C, H]-volume gathers,
-            # whose AD transposes are the matching scatter-adds. Dropped
-            # token-choices route to a trailing trash slot that reads as
-            # a zero row — identical semantics to the einsum path (pinned
-            # by tests/test_moe.py oracles either way).
+            # step (30%). Round 4 replaced them with gathers whose AD
+            # transposes were scatter-adds (still 14.1 ms of the 179 ms
+            # top-2 step); round 5's custom_vjp pair above re-expresses
+            # those transposes as gathers through the inverse slot map.
+            # Dropped token-choices route to a trailing trash slot that
+            # reads as a zero row — identical semantics to the einsum
+            # path (pinned by tests/test_moe.py oracles either way).
             flat_ids = jnp.where(kept, gate_idx * C + pos_idx, E * C)
-            slot_token = jnp.full((E * C + 1,), T, jnp.int32)
-            slot_token = slot_token.at[flat_ids.reshape(-1)].set(
-                jnp.broadcast_to(
-                    jnp.arange(T, dtype=jnp.int32)[:, None], (T, k)
-                ).reshape(-1)
-            )
-            x_pad = jnp.concatenate(
-                [xt.astype(dtype), jnp.zeros((1, H), dtype)], axis=0
-            )
-            expert_in = x_pad[slot_token[:E * C]].reshape(E, C, H)
+            # One scatter builds the inverse map: slot -> flat token-choice
+            # in choice-major order (j*T + t, trash -> k*T; see the
+            # custom_vjp comment for why choice-major).
+            tc_vals = (jnp.arange(T, dtype=jnp.int32)[:, None]
+                       + T * jnp.arange(k, dtype=jnp.int32)[None, :])
+            slot_tc = jnp.full((E * C + 1,), k * T, jnp.int32)
+            slot_tc = slot_tc.at[flat_ids.reshape(-1)].set(
+                tc_vals.reshape(-1)
+            )[:E * C]
+            slot_token = jnp.where(slot_tc == k * T, T, slot_tc % T)
+            expert_in = _dispatch_rows(
+                xt.astype(dtype), slot_token, flat_ids
+            ).reshape(E, C, H)
         else:
             # One-hot einsum dispatch (rounds 2-3): the routing rides the
             # MXU, and under expert parallelism GSPMD lowers the einsums
@@ -176,13 +283,8 @@ class MoEMLP(nn.Module):
         expert_out = jnp.einsum("eci,eih->ech", hmid, w_down)   # [E, C, H]
 
         if mode == "gather":
-            eo_pad = jnp.concatenate(
-                [expert_out.reshape(E * C, H),
-                 jnp.zeros((1, H), expert_out.dtype)], axis=0
-            )
-            contrib = eo_pad[flat_ids]                          # [T, k, H]
-            out = jnp.sum(
-                contrib * gates[..., None].astype(dtype), axis=1
+            out = _combine_rows(
+                expert_out.reshape(E * C, H), gates, flat_ids, slot_tc
             ).reshape(b, s, H)
         else:
             combine = jnp.sum(

@@ -52,11 +52,20 @@ _SHARDING_CHOICES = [
     "zero3", "zero2", "replicated", "ddp",
 ]
 
-# Host-offload storage dtypes the trainer implements (trainer.py:344-354).
-# Shared between the argparse choices and the YAML validation below — any
-# other string would flow into jnp.dtype() as a silently-corrupting storage
-# cast (e.g. int16 truncates Adam moments to zero).
+# Optimizer-state storage dtypes (host-offloaded: trainer.py _offload_*;
+# on-device: optimizer.py scale_by_adam_quantized). Shared between the
+# argparse choices and the YAML validation below — any other string would
+# flow into jnp.dtype() as a silently-corrupting storage cast (e.g. int16
+# truncates Adam moments to zero).
 _OFFLOAD_DTYPES = ["float32", "bfloat16", "int8"]
+
+
+def _require_choice(value, choices, name):
+    if value not in choices:
+        raise SystemExit(
+            f"{name} {value!r} not supported; choose one of {choices}"
+        )
+    return value
 
 
 def build_parser(mode: str) -> argparse.ArgumentParser:
@@ -146,6 +155,11 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--num_kv_heads", type=int, default=None,
                    help="grouped-query attention: K/V heads (< num_heads "
                         "shrinks the KV cache by the group factor)")
+    p.add_argument("--optimizer_state_dtype", default=None,
+                   choices=["float32", "bfloat16", "int8"],
+                   help="on-device Adam moment storage; narrow dtypes cut "
+                        "the HBM-bound optimizer update traffic (int8 = "
+                        "blockwise-absmax, second moment in sqrt-space)")
     p.add_argument("--multihost", action="store_true", default=None,
                    help="force jax.distributed.initialize() autodetect")
     p.add_argument("--device", type=str, default=None,
@@ -291,6 +305,11 @@ def resolve_configs(args, mode: str):
                               y_dist.get("mixed_precision"),
                               y_train.get("mixed_precision"),
                               defaults.mixed_precision),
+        optimizer_state_dtype=_require_choice(
+            _pick(args.optimizer_state_dtype,
+                  y_train.get("optimizer_state_dtype"),
+                  defaults.optimizer_state_dtype),
+            _OFFLOAD_DTYPES, "optimizer_state_dtype"),
         gradient_accumulation_steps=_picki(
             args.grad_accum, y_train.get("gradient_accumulation_steps"),
             defaults.gradient_accumulation_steps),
@@ -310,13 +329,10 @@ def resolve_configs(args, mode: str):
             _pick(getattr(args, "cpu_offload", None),
                   y_fsdp.get("cpu_offload"), False)
         )
-        offload_dtype = _pick(getattr(args, "offload_dtype", None),
-                              y_fsdp.get("offload_dtype"), "float32")
-        if offload_dtype not in _OFFLOAD_DTYPES:
-            raise SystemExit(
-                f"offload_dtype {offload_dtype!r} not supported; choose "
-                f"one of {_OFFLOAD_DTYPES}"
-            )
+        offload_dtype = _require_choice(
+            _pick(getattr(args, "offload_dtype", None),
+                  y_fsdp.get("offload_dtype"), "float32"),
+            _OFFLOAD_DTYPES, "offload_dtype")
         default_mesh = mesh_lib.MeshConfig(data=1, fsdp=-1)
     else:
         strategy = "replicated"

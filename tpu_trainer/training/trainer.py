@@ -50,7 +50,6 @@ from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.training.optimizer import make_optimizer
 
 _MP_TO_DTYPE = {"fp32": "float32", "bf16": "bfloat16", "fp16": "float16"}
-_QUANT_BLOCK = 256  # target block length for int8 offload quantization
 
 
 @jax.custom_vjp
@@ -85,43 +84,14 @@ def _linked_cast_bwd(_, g):
 _linked_cast.defvjp(_linked_cast_fwd, _linked_cast_bwd)
 
 
-def _quant_block_len(d: int) -> int:
-    """Largest of {256, 128, 64, 32} dividing ``d`` (else ``d`` itself —
-    one block per row)."""
-    for b in (256, 128, 64, 32):
-        if d % b == 0:
-            return b
-    return d
+# Blockwise int8 quantization now lives in utils/quant.py (shared with the
+# on-device quantized Adam state); re-exported here for its established
+# import path (tests/test_offload.py, validate.py).
+from tpu_trainer.utils.quant import (  # noqa: E402,F401
+    dequantize_blockwise_int8,
+    quantize_blockwise_int8,
+)
 
-
-def quantize_blockwise_int8(x: jax.Array, *, nonneg: bool) -> dict:
-    """Blockwise absmax int8 quantization along the LAST dim.
-
-    ``nonneg`` (Adam's second moment): quantize ``sqrt(x)`` instead — the
-    moment spans ~squared dynamic range, and v only enters the update
-    through ``sqrt(v)``, so quantizing in sqrt-space halves the log-range
-    the 8 bits must cover exactly where it matters (the bitsandbytes
-    "dynamic quantization" motivation, done with plain absmax + a sqrt
-    transform). Returns ``{"q": int8 [..., nb, B], "scale": f32 [..., nb]}``.
-    """
-    d = x.shape[-1]
-    blk = _quant_block_len(d)
-    y = x.astype(jnp.float32)
-    if nonneg:
-        y = jnp.sqrt(jnp.maximum(y, 0.0))
-    y = y.reshape(x.shape[:-1] + (d // blk, blk))
-    scale = jnp.max(jnp.abs(y), axis=-1) / 127.0
-    safe = jnp.maximum(scale, 1e-30)
-    q = jnp.round(y / safe[..., None]).astype(jnp.int8)
-    return {"q": q, "scale": scale}
-
-
-def dequantize_blockwise_int8(packed: dict, shape, dtype, *,
-                              nonneg: bool) -> jax.Array:
-    y = packed["q"].astype(jnp.float32) * packed["scale"][..., None]
-    if nonneg:
-        y = y * y
-    return y.reshape(shape).astype(dtype)
 _SCALE_GROWTH_INTERVAL = 2000  # steps of finite grads before doubling
 _MAX_LOSS_SCALE = 2.0**16
 _INIT_LOSS_SCALE = 2.0**15
@@ -322,6 +292,15 @@ class Trainer:
         # cpu_offload viability + host storage dtype must be known before
         # state shapes are traced (_make_state casts the stored state).
         self.cpu_offload = parallel_config.cpu_offload
+        if (self.cpu_offload
+                and training_config.optimizer_state_dtype != "float32"):
+            raise ValueError(
+                "cpu_offload streams the optimizer state from host storage "
+                "(--offload_dtype controls its width there); combine it "
+                "with optimizer_state_dtype=float32 — the on-device "
+                "quantized state targets HBM traffic, which offloaded "
+                "state does not generate"
+            )
         if self.cpu_offload:
             kinds = {
                 m.kind for d in self.mesh.devices.flat

@@ -153,6 +153,18 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
                 f"point --checkpoint_dir at a fresh directory, pass "
                 f"--no_auto_resume to start over, or match the saved config"
             )
+    # A different on-device Adam storage dtype changes the opt_state TREE
+    # (quantized moments are {"q", "scale"} packs) — fail with the knob's
+    # name instead of an orbax structure error.
+    saved_tc = meta.get("training_config") or {}
+    saved_osd = saved_tc.get("optimizer_state_dtype", "float32")
+    now_osd = trainer.training_config.optimizer_state_dtype
+    if saved_osd != now_osd:
+        raise ValueError(
+            f"checkpoint {path} was saved with optimizer_state_dtype="
+            f"{saved_osd!r} but this run uses {now_osd!r}; pass "
+            f"--optimizer_state_dtype {saved_osd} to resume it"
+        )
     # Checkpoints never hold params_c (stripped on save — derived data);
     # restore against the stripped structure, then rebuild the copy.
     shapes = shapes.replace(params_c=None)
