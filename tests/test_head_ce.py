@@ -84,6 +84,67 @@ class TestHeadCEKernel:
         for a, c in zip(g_o, g_p):
             np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
 
+    def test_sequence_sharded_shard_map_path(self):
+        # SP (round 5, VERDICT r4 #2): the sequence dim shards over the
+        # `sequence` axis; the caller's global shift/mask make each
+        # shard's label slice correct without a boundary exchange.
+        from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(data=1, sequence=8))
+        emb, x, labels, mask = _case(17, 2, 64, 32, 521, jnp.float32)
+        (l_o, g_o), (l_p, g_p) = _both(emb, x, labels, mask, mesh=mesh)
+        np.testing.assert_allclose(l_o, l_p, rtol=1e-6, atol=1e-6)
+        for a, c in zip(g_o, g_p):
+            np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
+
+    def test_batch_and_sequence_sharded_path(self):
+        # dp x sp jointly: the saved-logits residual is [V, b, s] exactly
+        # so this composition declares true shard positions (a flat
+        # [V, T] out-spec would permute the global token order).
+        from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(data=2, fsdp=2, sequence=2))
+        emb, x, labels, mask = _case(19, 4, 64, 32, 300, jnp.float32)
+        (l_o, g_o), (l_p, g_p) = _both(emb, x, labels, mask, mesh=mesh)
+        np.testing.assert_allclose(l_o, l_p, rtol=1e-6, atol=1e-6)
+        for a, c in zip(g_o, g_p):
+            np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
+
+    def test_expert_axis_does_not_block_kernel(self):
+        # An expert axis shards only expert params; tokens are replicated
+        # over it, so the kernel runs (round 5 — was a fallback).
+        from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(data=2, expert=4))
+        emb, x, labels, mask = _case(23, 4, 32, 32, 300, jnp.float32)
+        (l_o, g_o), (l_p, g_p) = _both(emb, x, labels, mask, mesh=mesh)
+        np.testing.assert_allclose(l_o, l_p, rtol=1e-6, atol=1e-6)
+        for a, c in zip(g_o, g_p):
+            np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
+
+    def test_tp_loss_matches_oracle(self):
+        # Single-stage TP: the vocab-sharded XLA head under a tensor-axis
+        # shard_map (ops/loss._tp_loss) — loss and grads vs the unsharded
+        # blockwise oracle. The embedding enters h-sharded, as stored.
+        from tpu_trainer.ops.loss import _tp_loss
+        from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(data=1, tensor=8))
+        emb, x, labels, mask = _case(29, 2, 64, 64, 521, jnp.float32)
+        b, s, _ = x.shape
+
+        def oracle(e_, x_):
+            return _chunked_ce(e_, x_, labels, mask, _chunk_len(b, s, 0))
+
+        def tp(e_, x_):
+            return _tp_loss(e_, x_, labels, mask, mesh, 0)
+
+        ro = jax.jit(jax.value_and_grad(oracle, argnums=(0, 1)))(emb, x)
+        rt = jax.jit(jax.value_and_grad(tp, argnums=(0, 1)))(emb, x)
+        np.testing.assert_allclose(ro[0], rt[0], rtol=1e-6, atol=1e-6)
+        for a, c in zip(ro[1], rt[1]):
+            np.testing.assert_allclose(a, c, rtol=1e-5, atol=1e-6)
+
     def test_dispatch_gate_off_cpu(self):
         # The model-level dispatch never routes to Pallas off-TPU.
         from tpu_trainer.ops.loss import _pallas_head_ok

@@ -129,8 +129,11 @@ class GPTConfig:
     # pass over the [tokens, vocab] block, and the backward reads saved
     # compute-dtype logits instead of re-using the f32 block. Loss stays
     # exact f32; backward probabilities carry bf16 rounding (same order as
-    # the flash kernel's backward). Falls back to the XLA blockwise path
-    # off-TPU and on meshes with sequence/stage/tensor/expert sharding.
+    # the flash kernel's backward). The kernel shard_maps over batch
+    # (data x fsdp) AND sequence axes, and an expert axis (which shards
+    # only expert params) does not block it. Falls back off-TPU; under a
+    # stage axis the pipeline owns the head, and under single-stage TP
+    # the loss routes to the vocab-sharded XLA head (ops/loss._tp_loss).
     fused_loss_pallas: bool = True
     # GPipe microbatch count when the mesh has a `stage` axis > 1
     # (parallel/pipeline.py); 0 = auto (one microbatch per stage). More

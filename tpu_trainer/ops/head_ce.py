@@ -186,20 +186,32 @@ def pallas_head_ce(emb, x, labels, mask, mesh=None, interpret=False):
     return _pallas_ce_fwd(emb, x, labels, mask, mesh, interpret)[0]
 
 
-def _batch_spec(mesh, b: int):
-    """Shard the batch dim over data x fsdp when it divides; None = do not
-    shard (replicated manual region, each shard computes the full loss)."""
+def _shard_axes(mesh, b: int, s: int):
+    """Mesh axes the kernel shard_maps over: ``(batch_axes, seq_axes)``.
+
+    Batch shards over data x fsdp when ``b`` divides; the sequence dim
+    shards over the ``sequence`` axis when ``s`` divides (the shift and
+    the position mask are computed GLOBALLY by the caller before dispatch,
+    so shard-local labels/mask slices are already correct — no boundary
+    exchange is needed at the kernel level). ``(None, None)`` = run the
+    kernel unsharded (replicated manual region).
+    """
     if mesh is None:
-        return None
-    from tpu_trainer.parallel.mesh import DATA_AXIS, FSDP_AXIS
+        return None, None
+    from tpu_trainer.parallel.mesh import (
+        DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS)
 
     axes = tuple(
         a for a in (DATA_AXIS, FSDP_AXIS) if mesh.shape.get(a, 1) > 1
     )
-    if not axes:
-        return None
-    size = int(np.prod([mesh.shape[a] for a in axes]))
-    return axes if b % size == 0 else None
+    size = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+    if axes and b % size != 0:
+        axes = ()
+    seq_axes = ()
+    if (mesh.shape.get(SEQUENCE_AXIS, 1) > 1
+            and s % mesh.shape[SEQUENCE_AXIS] == 0):
+        seq_axes = (SEQUENCE_AXIS,)
+    return (axes or None), (seq_axes or None)
 
 
 def _fwd_parts(emb, x, labels, mask, mesh, interpret):
@@ -207,28 +219,38 @@ def _fwd_parts(emb, x, labels, mask, mesh, interpret):
     e_c = emb.astype(x.dtype)
 
     def local(x_l, e_l, lab_l):
-        bl = x_l.shape[0]
+        bl, sl = x_l.shape[:2]
         logits_t, lse, ll = head_ce_forward(
-            x_l.reshape(bl * s, h), e_l, lab_l.reshape(bl * s),
+            x_l.reshape(bl * sl, h), e_l, lab_l.reshape(bl * sl),
             interpret=interpret,
         )
-        return logits_t, lse.reshape(bl, s), ll.reshape(bl, s)
+        # Saved logits as [V, b, s]: with the token dim factored, each
+        # shard's output declares its true (batch block, seq block)
+        # position — a flat [V, T] out-spec would permute the global
+        # token order when BOTH batch and sequence axes shard. A free
+        # bitcast when unsharded.
+        return (logits_t.reshape(-1, bl, sl), lse.reshape(bl, sl),
+                ll.reshape(bl, sl))
 
-    axes = _batch_spec(mesh, b)
-    if axes is None:
+    b_axes, s_axes = _shard_axes(mesh, b, s)
+    if b_axes is None and s_axes is None:
         logits_t, lse, ll = local(x, e_c, labels)
     else:
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
-        # Partial-manual over the batch axes only (the attention dispatch's
-        # pattern, ops/attention.py): other mesh axes stay under GSPMD.
-        # The transposed logits shard their TOKEN dim (dim 1).
+        # Partial-manual over the batch (and, round 5, sequence) axes only
+        # (the attention dispatch's pattern, ops/attention.py): other mesh
+        # axes stay under GSPMD. Under SP the caller's global shift/mask
+        # make the shard-local label slices correct as-is (see
+        # _shard_axes).
+        t_axes = tuple(b_axes or ()) + tuple(s_axes or ())
         logits_t, lse, ll = shard_map(
             local, mesh=mesh,
-            in_specs=(P(axes), P(), P(axes)),
-            out_specs=(P(None, axes), P(axes), P(axes)),
-            axis_names=set(axes),
+            in_specs=(P(b_axes, s_axes), P(), P(b_axes, s_axes)),
+            out_specs=(P(None, b_axes, s_axes), P(b_axes, s_axes),
+                       P(b_axes, s_axes)),
+            axis_names=set(t_axes),
             check_vma=False,
         )(x, e_c, labels)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
@@ -246,17 +268,41 @@ def _pallas_ce_bwd(mesh, interpret, res, g):
     emb, x, labels, mask, logits_t, lse, denom = res
     b, s, h = x.shape
     vocab = emb.shape[0]
-    T = b * s
     e_c = emb.astype(x.dtype)
-    x2 = x.reshape(T, h)
     scale = g / denom
 
     # (softmax - onehot) * weight, in the kernel's vocab-major layout —
     # XLA fuses the exp/onehot chain into the two matmuls' operand reads
-    # (this is why the kernel emits [V, T]: the row-major variant forced a
-    # measured 5 ms relayout + 4 ms convert before the matmuls), so no
-    # [V, T] f32 cotangent is ever materialized.
-    p_t = jnp.exp(logits_t.astype(jnp.float32)
+    # (this is why the kernel emits vocab-major: the row-major variant
+    # forced a measured 5 ms relayout + 4 ms convert before the matmuls),
+    # so no vocab-major f32 cotangent is ever materialized.
+    #
+    # Shape regime, decided at trace time from the mesh: the flat [V, T]
+    # form lowers to two plain GEMMs (the fast path — the factored 3-D
+    # dot_general measured 18% off the headline, 115.7k -> 94.9k tok/s);
+    # but when batch AND sequence axes BOTH shard the token dim, the
+    # merged T cannot carry the factored sharding and the reshape would
+    # reshard the largest buffer of the step — there the backward stays
+    # in the residual's [V, b, s] form.
+    b_axes, s_axes = _shard_axes(mesh, b, s)
+    if b_axes is not None and s_axes is not None:
+        p_t = jnp.exp(logits_t.astype(jnp.float32) - lse[None, :, :])
+        rows = jax.lax.broadcasted_iota(jnp.int32, (vocab, b, s), 0)
+        onehot_t = (rows == labels[None, :, :]).astype(jnp.float32)
+        dlg_t = ((p_t - onehot_t)
+                 * (mask * scale)[None, :, :]).astype(x.dtype)  # [V, b, s]
+        dx = jax.lax.dot_general(
+            dlg_t, e_c, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)  # [b, s, h]
+        de = jax.lax.dot_general(
+            dlg_t, x, (((1, 2), (0, 1)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(emb.dtype)  # [V, h]
+        return de, dx, None, None
+
+    T = b * s
+    p_t = jnp.exp(logits_t.reshape(vocab, T).astype(jnp.float32)
                   - lse.reshape(T)[None, :])
     rows = jax.lax.broadcasted_iota(jnp.int32, (vocab, T), 0)
     onehot_t = (rows == labels.reshape(T)[None, :]).astype(jnp.float32)
@@ -267,7 +313,7 @@ def _pallas_ce_bwd(mesh, interpret, res, g):
         preferred_element_type=jnp.float32,
     ).astype(x.dtype).reshape(b, s, h)
     de = jax.lax.dot_general(
-        dlg_t, x2, (((1,), (0,)), ((), ())),
+        dlg_t, x.reshape(T, h), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     ).astype(emb.dtype)
     return de, dx, None, None
