@@ -68,6 +68,9 @@ def _build_parser():
                    help="host-offload optimizer state (pinned_host stream)")
     p.add_argument("--offload-dtype", default="float32",
                    help="offloaded-state storage: float32 | bfloat16 | int8")
+    p.add_argument("--offload-budget-gb", type=float, default=0.0,
+                   help="partial offload: GB of the largest moment leaves "
+                        "kept device-resident (exact f32)")
     p.add_argument("--opt-state-dtype", default="float32",
                    help="on-device Adam moment storage: float32 | bfloat16 "
                         "| int8 (TrainingConfig.optimizer_state_dtype)")
@@ -133,7 +136,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
               remat, mesh_cfg, strategy, devices=None, offload=False,
               offload_dtype="float32", num_experts=0, moe_top_k=1,
               model_flags=None, carry_cast=True,
-              opt_state_dtype="float32"):
+              opt_state_dtype="float32", offload_budget_gb=0.0):
     """One measured config -> result dict. ``batch_size`` is per data shard
     (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
@@ -162,14 +165,19 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         # routed experts (models/moe.py); z-loss at the recommended 1e-3.
         common.update(num_experts=num_experts, moe_top_k=moe_top_k,
                       router_z_weight=1e-3)
-    if model_flags:
-        common.update(model_flags)
     if model_size == "tiny":
         # Correctness-mode size for CPU dry runs of the harness itself.
         model_config = GPTConfig(vocab_size=256, hidden_size=64,
                                  num_layers=2, num_heads=4, **common)
     else:
         model_config = GPTConfig.preset(model_size, **common)
+    if model_flags:
+        # Applied AFTER the preset so flags may override preset-fixed
+        # fields too (e.g. num_heads=6 for the d=128 geometry experiment);
+        # the frozen-dataclass replace re-runs __post_init__ validation.
+        import dataclasses as _dc
+
+        model_config = _dc.replace(model_config, **model_flags)
     training_config = TrainingConfig(
         batch_size=batch_size,
         max_seq_len=seq_len,
@@ -182,7 +190,8 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     trainer = Trainer(model_config, training_config,
                       ParallelConfig(mesh_cfg, strategy or "replicated",
                                      cpu_offload=offload,
-                                     offload_dtype=offload_dtype),
+                                     offload_dtype=offload_dtype,
+                                     offload_budget_gb=offload_budget_gb),
                       mesh=mesh)
 
     loader = create_dummy_dataloader(
@@ -421,6 +430,7 @@ def main() -> None:
         model_flags=_parse_model_flags(args.model_flag),
         carry_cast=bool(args.carry_cast),
         opt_state_dtype=args.opt_state_dtype,
+        offload_budget_gb=args.offload_budget_gb,
     )
     result = {
         "metric": "train_tokens_per_sec",

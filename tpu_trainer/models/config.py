@@ -184,6 +184,14 @@ class GPTConfig:
     # set internally by generate_kv(prompt_lens=...); uniform decode keeps
     # the cheaper shared-position attention. Not a training knob.
     decode_ragged: bool = False
+    # KV-cache view length for decode (0 = max_seq_len). generate_kv sets
+    # this per call to prompt+new rounded up to 128: the cache allocates
+    # and the decode attention reads only this prefix instead of the full
+    # max_seq_len buffer — the attention's HBM reads scale with what can
+    # actually be filled, not the model's context limit (VERDICT r4 #5).
+    # Static, so it participates in jit specialization like the prompt
+    # shape already does.
+    decode_window: int = 0
 
     # REPRODUCIBILITY NOTE: fused_loss, fast_dropout, and scan_unroll
     # default on as of v0.2, and the dropout-hash gained a second mix round

@@ -263,7 +263,13 @@ class CausalSelfAttention(nn.Module):
         cfg = self.config
         b, s, h, d = q.shape
         kvh = k.shape[2]  # num_kv_heads: the GQA cache is group-fold smaller
+        # Cache length: the static decode window when set (generate_kv
+        # sizes it to prompt+new rounded to 128) — the buffer, the DUS
+        # writes, and every attention read scale with it instead of the
+        # full context limit.
         max_len = cfg.max_seq_len
+        if 0 < cfg.decode_window < max_len:
+            max_len = cfg.decode_window
         ck = self.variable(
             "cache", "k", jnp.zeros, (b, max_len, kvh, d), cfg.compute_dtype
         )
@@ -855,7 +861,6 @@ def _generate_kv_jit(
         # Static switch: the per-row pad machinery only traces when asked
         # for (uniform decode keeps the cheaper shared-position path).
         config = _dc.replace(config, decode_ragged=True)
-    model = GPT(config)
     b, prompt_len = input_ids.shape
     total = prompt_len + max_new_tokens
     if total > config.max_seq_len:
@@ -864,6 +869,15 @@ def _generate_kv_jit(
             f"exceeds the cache size (max_seq_len={config.max_seq_len}); "
             f"use generate() for windowed generation"
         )
+    # Size the KV cache to what this call can actually fill (128-bucketed
+    # so nearby shapes share a compile), not the model's context limit:
+    # the decode attention's HBM reads are proportional to the cache
+    # view, so a 384-token request against a 1024-token cache was paying
+    # 2.7x the necessary read volume every step (VERDICT r4 #5).
+    config = _dc.replace(
+        config, decode_window=min(-(-total // 128) * 128, config.max_seq_len)
+    )
+    model = GPT(config)
     if max_new_tokens == 0:
         return input_ids
     cache = init_cache(config, b)

@@ -279,13 +279,14 @@ def _pallas_ce_bwd(mesh, interpret, res, g):
     #
     # Shape regime, decided at trace time from the mesh: the flat [V, T]
     # form lowers to two plain GEMMs (the fast path — the factored 3-D
-    # dot_general measured 18% off the headline, 115.7k -> 94.9k tok/s);
-    # but when batch AND sequence axes BOTH shard the token dim, the
-    # merged T cannot carry the factored sharding and the reshape would
-    # reshard the largest buffer of the step — there the backward stays
-    # in the residual's [V, b, s] form.
+    # dot_general measured 18% off the headline, 115.7k -> 94.9k tok/s).
+    # But whenever the SEQUENCE axis shards dim 2 of the residual, the
+    # merged T cannot carry that sharding (batch-only sharding merges
+    # fine — T blocks stay contiguous) and the reshape would reshard the
+    # largest buffer of the step — there the backward stays in the
+    # residual's [V, b, s] form.
     b_axes, s_axes = _shard_axes(mesh, b, s)
-    if b_axes is not None and s_axes is not None:
+    if s_axes is not None:
         p_t = jnp.exp(logits_t.astype(jnp.float32) - lse[None, :, :])
         rows = jax.lax.broadcasted_iota(jnp.int32, (vocab, b, s), 0)
         onehot_t = (rows == labels[None, :, :]).astype(jnp.float32)

@@ -177,6 +177,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                        help="host storage dtype for offloaded optimizer "
                             "state; bfloat16 halves the host-link stream, "
                             "int8 (blockwise-absmax moments) quarters it")
+        p.add_argument("--offload_budget_gb", type=float, default=None,
+                       help="partial offload: GB of the largest optimizer-"
+                            "moment leaves kept device-resident (exact "
+                            "f32); only the overflow streams to host")
         p.add_argument("--no_activation_checkpointing", action="store_true",
                        default=None)
     return p
@@ -322,6 +326,7 @@ def resolve_configs(args, mode: str):
     # --- parallelism ---------------------------------------------------
     cpu_offload = False
     offload_dtype = "float32"
+    offload_budget_gb = 0.0
     if mode == "fsdp":
         strategy = _pick(getattr(args, "sharding", None),
                          y_fsdp.get("sharding_strategy"), "FULL_SHARD")
@@ -333,6 +338,9 @@ def resolve_configs(args, mode: str):
             _pick(getattr(args, "offload_dtype", None),
                   y_fsdp.get("offload_dtype"), "float32"),
             _OFFLOAD_DTYPES, "offload_dtype")
+        offload_budget_gb = _pickf(
+            getattr(args, "offload_budget_gb", None),
+            y_fsdp.get("offload_budget_gb"), 0.0)
         default_mesh = mesh_lib.MeshConfig(data=1, fsdp=-1)
     else:
         strategy = "replicated"
@@ -353,7 +361,8 @@ def resolve_configs(args, mode: str):
     )
     parallel_config = ParallelConfig(
         mesh=mesh_config, sharding_strategy=strategy,
-        cpu_offload=cpu_offload, offload_dtype=offload_dtype
+        cpu_offload=cpu_offload, offload_dtype=offload_dtype,
+        offload_budget_gb=offload_budget_gb,
     )
 
     data_opts = {
@@ -482,6 +491,11 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         print(f"model: {model_config.num_parameters():,} params | "
               f"global batch {trainer.global_batch_size} seqs x "
               f"{training_config.max_seq_len} tokens")
+        if trainer.cpu_offload and trainer.offload_resident_bytes:
+            print(f"partial offload: "
+                  f"{trainer.offload_resident_bytes / 2**30:.2f} GB of "
+                  f"optimizer moments device-resident (exact f32), "
+                  f"overflow streams to host")
 
     # --- resume (SURVEY.md §5.3: actually wired) -----------------------
     state = None

@@ -92,6 +92,40 @@ from tpu_trainer.utils.quant import (  # noqa: E402,F401
     quantize_blockwise_int8,
 )
 
+
+def _path_keys(path) -> tuple:
+    """Pytree path -> hashable tuple of key strings."""
+    return tuple(
+        str(getattr(p, "key", getattr(p, "name", getattr(p, "idx", ""))))
+        for p in path
+    )
+
+
+def select_resident_moments(opt_shapes, budget_bytes: int):
+    """Partial-offload selection: which optimizer-state leaves stay on
+    device under a byte budget (VERDICT r4 #3).
+
+    Greedy largest-first over the float ndim>=1 leaves (the stream is
+    volume-bound, so the biggest leaves buy the most link traffic per
+    selection; Adam's mu/nu for one param are equal-sized and selected
+    together or not at all only by budget coincidence — fine, each leaf
+    streams independently). Scalars never stream anyway. Returns
+    ``(frozenset of path-key tuples, bytes kept)``.
+    """
+    cands = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_shapes)[0]:
+        if (getattr(leaf, "ndim", 0) >= 1
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            cands.append((_path_keys(path),
+                          leaf.size * jnp.dtype(leaf.dtype).itemsize))
+    cands.sort(key=lambda kv: (-kv[1], kv[0]))
+    keep, used = set(), 0
+    for pk, sz in cands:
+        if used + sz <= budget_bytes:
+            keep.add(pk)
+            used += sz
+    return frozenset(keep), used
+
 _SCALE_GROWTH_INTERVAL = 2000  # steps of finite grads before doubling
 _MAX_LOSS_SCALE = 2.0**16
 _INIT_LOSS_SCALE = 2.0**15
@@ -150,12 +184,20 @@ class ParallelConfig:
       log-range (the bitsandbytes dynamic-quantization motivation).
       Default f32 keeps the offloaded step bitwise-identical to the
       on-device one.
+    - ``offload_budget_gb`` (round 5, VERDICT r4 #3 — partial offload):
+      GB of optimizer-moment leaves allowed to REMAIN device-resident,
+      largest-first; only the overflow streams over the host link. The
+      stream is volume-bound, so every resident GB is ~2 GB/step less
+      link traffic at f32 (read + write) — resident leaves skip the
+      storage transform entirely and keep the bitwise-f32 contract.
+      0 = classic full offload.
     """
 
     mesh: mesh_lib.MeshConfig = mesh_lib.MeshConfig()
     sharding_strategy: str = "replicated"
     cpu_offload: bool = False
     offload_dtype: str = "float32"
+    offload_budget_gb: float = 0.0
 
 
 class Trainer:
@@ -331,6 +373,25 @@ class Trainer:
             if self.cpu_offload and not self._offload_quant
             and parallel_config.offload_dtype != "float32" else None
         )
+        # Partial offload (offload_budget_gb): leaves in _offload_keep stay
+        # device-resident in exact f32. Selection needs the optimizer-state
+        # shapes BEFORE _make_state is traced (its _offload_store consults
+        # the keep set), hence this separate abstract trace.
+        self._offload_keep = frozenset()
+        self.offload_resident_bytes = 0  # surfaced in the CLI startup line
+        if self.cpu_offload and parallel_config.offload_budget_gb > 0:
+            p_shapes = jax.eval_shape(
+                lambda rng: self.model.init(
+                    rng, jnp.zeros((1, 8), jnp.int32))["params"],
+                jax.random.PRNGKey(0),
+            )
+            opt_shapes = jax.eval_shape(self.optimizer.init, p_shapes)
+            self._offload_keep, self.offload_resident_bytes = (
+                select_resident_moments(
+                    opt_shapes,
+                    int(parallel_config.offload_budget_gb * 2**30),
+                )
+            )
 
         # --- shardings, from shapes only (no allocation) -------------------
         state_shapes = jax.eval_shape(self._make_state, jax.random.PRNGKey(0))
@@ -371,10 +432,15 @@ class Trainer:
             # partitioner rejects placement annotations on scalars, and
             # they're bytes anyway.
             self._opt_device_shardings = self.state_shardings.opt_state
-            self._opt_host_shardings = jax.tree_util.tree_map(
-                lambda ns, shape: (
+            # Partial offload: leaves in _offload_keep keep their device
+            # sharding (their pre-pack paths survive because kept leaves
+            # skip the storage transform, so pack-extended paths — 'q'/
+            # 'scale' — are never in the keep set).
+            self._opt_host_shardings = jax.tree_util.tree_map_with_path(
+                lambda path, ns, shape: (
                     NamedSharding(self.mesh, ns.spec, memory_kind="pinned_host")
-                    if getattr(shape, "ndim", 0) >= 1 else ns
+                    if getattr(shape, "ndim", 0) >= 1
+                    and _path_keys(path) not in self._offload_keep else ns
                 ),
                 self.state_shardings.opt_state,
                 state_shapes.opt_state,
@@ -465,10 +531,14 @@ class Trainer:
     def _offload_store(self, opt_state):
         """Compute-dtype optimizer state -> host storage form (no-op unless
         ``offload_dtype`` narrows it; "int8" packs ndim>=2 float leaves
-        into blockwise {q, scale})."""
+        into blockwise {q, scale}). Device-resident leaves under a partial
+        offload budget (``self._offload_keep``) skip the transform — they
+        never cross the link, so they stay exact f32."""
+        keep = self._offload_keep
         if self._offload_quant:
             return jax.tree_util.tree_map_with_path(
                 lambda path, x: x if self._is_packed(x)
+                or _path_keys(path) in keep
                 else quantize_blockwise_int8(
                     x, nonneg=self._path_nonneg(path))
                 if getattr(x, "ndim", 0) >= 2
@@ -478,10 +548,11 @@ class Trainer:
             )
         if self._offload_cast is None:
             return opt_state
-        return jax.tree_util.tree_map(
-            lambda x: x.astype(self._offload_cast)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: x.astype(self._offload_cast)
             if getattr(x, "ndim", 0) >= 1
-            and jnp.issubdtype(x.dtype, jnp.floating) else x,
+            and jnp.issubdtype(x.dtype, jnp.floating)
+            and _path_keys(path) not in keep else x,
             opt_state,
         )
 
