@@ -117,6 +117,22 @@ class TestConfigResolution:
         _, _, parallel, _ = resolve_configs(args, "fsdp")
         assert parallel.cpu_offload and parallel.offload_dtype == "int8"
 
+    def test_all_shipped_configs_parse(self):
+        # Every YAML under configs/ must resolve through the CLI layering
+        # (schema drift between shipped examples and the loader is a user-
+        # facing break the suite should catch).
+        import glob
+
+        cfgs = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "configs", "*.yaml")))
+        assert cfgs, "no shipped configs found"
+        for path in cfgs:
+            for mode in ("ddp", "fsdp"):
+                args = build_parser(mode).parse_args(["--config", path])
+                model, train, parallel, data = resolve_configs(args, mode)
+                assert model.num_parameters() > 0, path
+
     def test_optimizer_state_dtype_reaches_training_config(self, tiny_yaml):
         for dt in ("float32", "bfloat16", "int8"):
             args = build_parser("ddp").parse_args(
