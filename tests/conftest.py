@@ -34,9 +34,9 @@ def pytest_report_header(config):
 # (pure-function math, data pipeline, harness logic, logging).
 _SLOW_MODULES = {
     "test_checkpoint", "test_cli", "test_decode", "test_distributed",
-    "test_flash", "test_gqa", "test_head_ce", "test_infer", "test_model",
-    "test_moe", "test_offload", "test_optimizer_q", "test_pipeline",
-    "test_ring", "test_tensor_parallel", "test_trainer",
+    "test_faults", "test_flash", "test_gqa", "test_head_ce", "test_infer",
+    "test_model", "test_moe", "test_offload", "test_optimizer_q",
+    "test_pipeline", "test_ring", "test_tensor_parallel", "test_trainer",
 }
 # The biggest time sinks; `-m "slow and not heavy"` stays under 10 min and
 # `-m heavy` is the budgeted long lane for capped CI processes.
@@ -48,8 +48,9 @@ _SLOW_MODULES = {
 #   heavy              ~16 min (cli, distributed, pipeline incl. the
 #                              dropout-on schedule-equivalence run, ring,
 #                              moe, tensor_parallel, decode)
-_HEAVY_MODULES = {"test_cli", "test_decode", "test_distributed", "test_moe",
-                  "test_pipeline", "test_ring", "test_tensor_parallel"}
+_HEAVY_MODULES = {"test_cli", "test_decode", "test_distributed",
+                  "test_faults", "test_moe", "test_pipeline", "test_ring",
+                  "test_tensor_parallel"}
 
 
 def pytest_collection_modifyitems(config, items):

@@ -158,3 +158,90 @@ class TestCheckpoint:
         params, config = ckpt.restore_params(path)
         assert config == MODEL
         assert_tree_equal(state.params, params, rtol=0, atol=0)
+
+
+class TestCheckpointHardening:
+    """Quarantine/GC/torn-write behavior (the crash-safety layer around
+    save/restore; driven by utils/faults.py in the integration tests)."""
+
+    def _save_steps(self, tmp_path, trainer, steps, **kw):
+        state = trainer.init_state()
+        paths = []
+        for s in steps:
+            state = state.replace(step=jax.numpy.asarray(s, state.step.dtype))
+            paths.append(ckpt.save_checkpoint(
+                str(tmp_path), state, model_config=MODEL,
+                training_config=TRAIN, **kw))
+        return state, paths
+
+    def test_truncated_meta_is_skipped_not_fatal(self, tmp_path):
+        # A torn meta.json write used to brick every later auto-resume with
+        # a JSONDecodeError out of latest_checkpoint.
+        trainer = make_trainer()
+        _, (p1, p2) = self._save_steps(tmp_path, trainer, [1, 2])
+        open(f"{p2}/meta.json", "w").close()   # torn write: 0 bytes
+        assert ckpt.latest_checkpoint(str(tmp_path)) == p1
+        open(f"{p1}/meta.json", "w").close()
+        assert ckpt.latest_checkpoint(str(tmp_path)) is None
+
+    def test_gc_keeps_newest_n(self, tmp_path):
+        import os
+        trainer = make_trainer()
+        self._save_steps(tmp_path, trainer, [1, 2, 3], keep_last_n=2)
+        kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+        assert kept == ["step_00000002", "step_00000003"]
+
+    def test_gc_never_counts_incomplete_checkpoints(self, tmp_path):
+        # An in-flight save (state/ written, meta.json not yet) must neither
+        # count toward keep_last_n nor be deleted out from under the writer.
+        import os
+        trainer = make_trainer()
+        inflight = tmp_path / "step_00000099" / "state"
+        inflight.mkdir(parents=True)
+        self._save_steps(tmp_path, trainer, [1, 2, 3], keep_last_n=2)
+        names = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+        assert names == ["step_00000002", "step_00000003", "step_00000099"]
+
+    def test_restore_latest_quarantines_and_falls_back(self, tmp_path):
+        import os
+        trainer = make_trainer()
+        state, (p1, p2) = self._save_steps(tmp_path, trainer, [1, 2])
+        ckpt._corrupt_some_shard(p2)
+        restored = ckpt.restore_latest(str(tmp_path), trainer)
+        assert restored is not None
+        got_state, meta, path = restored
+        assert path == p1 and meta["step"] == 1
+        assert int(got_state.step) == 1
+        names = os.listdir(tmp_path)
+        assert "step_00000002" not in names
+        assert any(n.startswith("step_00000002.corrupt") for n in names)
+
+    def test_restore_latest_empty_dir(self, tmp_path):
+        trainer = make_trainer()
+        assert ckpt.restore_latest(str(tmp_path), trainer) is None
+        assert ckpt.restore_latest(str(tmp_path / "nope"), trainer) is None
+
+    def test_restore_latest_does_not_mask_incompatibility(self, tmp_path):
+        # Config mismatch is the user's mistake, not corruption: quarantining
+        # a perfectly good checkpoint from another model would destroy it.
+        trainer = make_trainer()
+        self._save_steps(tmp_path, trainer, [1])
+        bigger = dataclasses.replace(MODEL, hidden_size=64, num_heads=8)
+        mesh = make_mesh(MeshConfig(data=8, fsdp=1))
+        other = Trainer(bigger, TRAIN,
+                        ParallelConfig(MeshConfig(data=8, fsdp=1),
+                                       "replicated"), mesh=mesh)
+        with pytest.raises(ckpt.CheckpointIncompatibleError):
+            ckpt.restore_latest(str(tmp_path), other)
+        import os
+        assert os.path.isdir(tmp_path / "step_00000001")  # untouched
+
+    def test_data_state_roundtrips_through_meta(self, tmp_path):
+        trainer = make_trainer()
+        sd = {"kind": "dummy", "epoch": 1, "batch_index": 5, "seed": 7}
+        state = trainer.init_state()
+        path = ckpt.save_checkpoint(
+            str(tmp_path), state, model_config=MODEL, training_config=TRAIN,
+            data_state=sd)
+        _, meta = ckpt.restore_checkpoint(path, trainer)
+        assert meta["data_state"] == sd

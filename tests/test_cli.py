@@ -133,6 +133,50 @@ class TestConfigResolution:
                 model, train, parallel, data = resolve_configs(args, mode)
                 assert model.num_parameters() > 0, path
 
+    def test_fault_tolerance_flags_parse_for_all_shipped_configs(self):
+        # The rollback/GC/injection flags must layer over every shipped
+        # YAML — an example config that rejects --keep_last_n would make
+        # the fault-tolerance docs a lie.
+        import glob
+
+        cfgs = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "configs", "*.yaml")))
+        assert cfgs, "no shipped configs found"
+        for path in cfgs:
+            for mode in ("ddp", "fsdp"):
+                args = build_parser(mode).parse_args(
+                    ["--config", path, "--keep_last_n", "2",
+                     "--max_rollbacks", "3", "--skip_batches_on_rollback",
+                     "2", "--rollback_lr_backoff", "0.25",
+                     "--inject_fault", "nan_loss@5"])
+                _, _, _, data = resolve_configs(args, mode)
+                assert data["keep_last_n"] == 2, path
+                assert data["max_rollbacks"] == 3, path
+                assert data["skip_batches_on_rollback"] == 2, path
+                assert data["rollback_lr_backoff"] == 0.25, path
+                assert data["inject_fault"] == "nan_loss@5", path
+
+    def test_fault_tolerance_yaml_section(self, tmp_path):
+        p = tmp_path / "ft.yaml"
+        p.write_text(TINY_YAML + "checkpoint:\n  keep_last_n: 3\n"
+                     "fault_tolerance:\n  max_rollbacks: 5\n"
+                     "  skip_batches_on_rollback: 0\n"
+                     "  rollback_lr_backoff: 1.0\n")
+        args = build_parser("ddp").parse_args(["--config", str(p)])
+        _, _, _, data = resolve_configs(args, "ddp")
+        assert data["keep_last_n"] == 3
+        assert data["max_rollbacks"] == 5
+        assert data["skip_batches_on_rollback"] == 0
+        assert data["rollback_lr_backoff"] == 1.0
+        # ...and the documented defaults with no section at all.
+        args = build_parser("ddp").parse_args([])
+        _, _, _, data = resolve_configs(args, "ddp")
+        assert data["keep_last_n"] == 0
+        assert data["max_rollbacks"] == 2
+        assert data["skip_batches_on_rollback"] == 1
+        assert data["rollback_lr_backoff"] == 0.5
+
     def test_optimizer_state_dtype_reaches_training_config(self, tiny_yaml):
         for dt in ("float32", "bfloat16", "int8"):
             args = build_parser("ddp").parse_args(

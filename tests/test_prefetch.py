@@ -42,6 +42,40 @@ class TestPrefetcher:
         with pytest.raises(RuntimeError, match="boom"):
             list(it)
 
+    def test_producer_traceback_preserved(self):
+        """The consumer-side re-raise must carry the producer's frames —
+        'RuntimeError somewhere in a thread' is undebuggable on a pod."""
+        import traceback
+
+        def explode_in_producer():
+            return 1 // 0
+
+        def bad():
+            yield 1
+            explode_in_producer()
+
+        it = iter(Prefetcher(bad, depth=2))
+        next(it)
+        with pytest.raises(ZeroDivisionError) as excinfo:
+            next(it)
+        frames = [f.name for f in traceback.extract_tb(
+            excinfo.value.__traceback__)]
+        assert "explode_in_producer" in frames
+
+    def test_all_batches_before_failure_delivered(self):
+        """The error arrives in-band *after* every good batch — a silently
+        shortened epoch would be misread as dataset exhaustion by the
+        resume/rollback machinery."""
+        def bad():
+            yield from range(5)
+            raise RuntimeError("late")
+
+        got = []
+        with pytest.raises(RuntimeError, match="late"):
+            for x in Prefetcher(bad, depth=2):
+                got.append(x)
+        assert got == [0, 1, 2, 3, 4]
+
     def test_early_break_stops_producer(self):
         produced = []
 

@@ -46,20 +46,54 @@ class DummyDataLoader:
         self.process_index = process_index
         self.process_count = process_count
         self.local_batch_size = batch_size // process_count
+        # Consumer-side cursor for exact resume (utils/checkpoint.py persists
+        # it into meta.json as "data_state"; see TextDataLoader for the
+        # real-data twin of this protocol).
+        self._cur_epoch = 0
+        self._cur_batch = 0
+        self._resume_skip = 0
 
     def __len__(self) -> int:
         return self.num_batches
 
+    def state_dict(self) -> dict:
+        """Exact data-stream position: batches *consumed* this epoch (the
+        cursor advances before each yield, so a checkpoint taken after
+        training on batch k records k+1 — resuming continues at k+1)."""
+        return {
+            "kind": "dummy",
+            "epoch": self._cur_epoch,
+            "batch_index": self._cur_batch,
+            "seed": self.seed,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state.get("kind", "dummy") != "dummy":
+            raise ValueError(
+                f"data state kind {state.get('kind')!r} does not match this "
+                f"dummy loader — the resumed run changed --dataset"
+            )
+        self._cur_epoch = int(state["epoch"])
+        self._cur_batch = int(state["batch_index"])
+        self._resume_skip = self._cur_batch
+
     def __iter__(self) -> Iterator[np.ndarray]:
-        for i in range(self.num_batches):
+        start = self._resume_skip
+        self._resume_skip = 0
+        self._cur_batch = start
+        for i in range(start, self.num_batches):
             # Batch i is a pure function of (seed, i): all processes agree on
-            # the global batch and carve out disjoint row ranges.
+            # the global batch and carve out disjoint row ranges — and a
+            # resumed run regenerates batch i bit-exactly from the cursor.
             rng = np.random.default_rng((self.seed, i))
             batch = rng.integers(
                 0, self.vocab_size, (self.batch_size, self.seq_len), dtype=np.int32
             )
             lo = self.process_index * self.local_batch_size
+            self._cur_batch = i + 1
             yield batch[lo : lo + self.local_batch_size]
+        self._cur_epoch += 1
+        self._cur_batch = 0
 
 
 def create_dummy_dataloader(

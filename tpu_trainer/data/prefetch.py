@@ -21,10 +21,24 @@ import threading
 from typing import Callable, Iterable, Iterator
 
 
+class _ProducerError:
+    """In-band carrier for a producer-thread exception: queued *after* the
+    batches produced before the failure, so the consumer sees every good
+    batch and then the error — never a silently-shortened epoch (which a
+    resume/rollback loop would misread as dataset exhaustion)."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class Prefetcher:
     """Iterate ``make_iter()`` on a background thread, ``depth`` items ahead.
 
-    - Exceptions in the producer re-raise at the consumer's next pull.
+    - Exceptions in the producer re-raise in the consumer with the
+      producer's original traceback (the frames below ``__iter__`` are the
+      producer's), after all batches produced before the failure.
     - Early termination (consumer breaks / generator closed) signals the
       producer to stop; the thread is a daemon either way.
     - Each ``__iter__`` starts a fresh producer (epoch semantics match the
@@ -42,7 +56,6 @@ class Prefetcher:
     def __iter__(self) -> Iterator:
         q: queue.Queue = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
-        exc: list = []
 
         def _put(item) -> bool:
             while not stop.is_set():
@@ -58,9 +71,9 @@ class Prefetcher:
                 for item in self._make_iter():
                     if not _put(item):
                         return
-            except BaseException as e:  # re-raised on the consumer side
-                exc.append(e)
-            _put(self._SENTINEL)
+                _put(self._SENTINEL)
+            except BaseException as e:  # delivered in-band, re-raised below
+                _put(_ProducerError(e))
 
         thread = threading.Thread(
             target=produce, daemon=True, name="tpu-trainer-prefetch"
@@ -70,9 +83,12 @@ class Prefetcher:
             while True:
                 item = q.get()
                 if item is self._SENTINEL:
-                    if exc:
-                        raise exc[0]
                     return
+                if isinstance(item, _ProducerError):
+                    # Same exception object: its __traceback__ still points
+                    # into the producer's frames, so the re-raise reads like
+                    # the failure happened inline.
+                    raise item.exc.with_traceback(item.exc.__traceback__)
                 yield item
         finally:
             stop.set()

@@ -402,6 +402,49 @@ class TextDataLoader:
         self.prefetch = prefetch
         self.epoch = 0
         self.streaming = not hasattr(dataset, "__len__")
+        # Consumer-side cursor for exact resume: which epoch is being
+        # iterated and how many batches the *consumer* has pulled from it.
+        # Counted here (not in the producer) because with prefetch the
+        # background thread runs batches ahead of what training actually
+        # consumed — a crash must resume at the consumed position.
+        self._cur_epoch = 0
+        self._cur_batch = 0
+        self._resume_skip = 0
+
+    def state_dict(self) -> dict:
+        """Exact data-stream position, persisted into checkpoint meta.json.
+
+        ``batch_index`` counts batches *consumed* in epoch ``epoch`` (the
+        cursor advances before each yield, so a checkpoint taken after
+        training on batch k records k+1). The shuffle RNG needs no separate
+        state: the map-style permutation is a pure function of
+        ``(seed, epoch)`` and the streaming line order is the file order.
+        """
+        return {
+            "kind": "streaming" if self.streaming else "map",
+            "epoch": self._cur_epoch,
+            "batch_index": self._cur_batch,
+            "seed": self.seed,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Position the next ``__iter__`` at the saved cursor.
+
+        Map-style re-derives the epoch's permutation and jumps straight to
+        the batch (index arithmetic, no re-tokenization); streaming
+        fast-forwards by re-reading and discarding ``batch_index`` batches —
+        exact, because the stream is a deterministic function of the file.
+        """
+        kind = state.get("kind", "map")
+        here = "streaming" if self.streaming else "map"
+        if kind != here:
+            raise ValueError(
+                f"data state kind {kind!r} does not match this {here!r} "
+                f"loader — the resumed run changed --dataset/--streaming"
+            )
+        self.epoch = self._cur_epoch = int(state["epoch"])
+        self._cur_batch = int(state["batch_index"])
+        self._resume_skip = self._cur_batch
 
     def __iter__(self) -> Iterator[np.ndarray]:
         # Map-style epoch state advances HERE, on the consumer's thread, not
@@ -411,23 +454,36 @@ class TextDataLoader:
         epoch = self.epoch
         if not self.streaming:
             self.epoch += 1
-        make = functools.partial(self._iter_batches, epoch)
+        start = self._resume_skip
+        self._resume_skip = 0
+        self._cur_epoch = epoch
+        self._cur_batch = start
+        make = functools.partial(self._iter_batches, epoch, start)
         if self.prefetch > 0:
             from tpu_trainer.data.prefetch import Prefetcher
 
-            yield from Prefetcher(make, self.prefetch)
+            it = iter(Prefetcher(make, self.prefetch))
         else:
-            yield from make()
+            it = make()
+        for batch in it:
+            self._cur_batch += 1
+            yield batch
+        self._cur_epoch = epoch + 1
+        self._cur_batch = 0
 
-    def _iter_batches(self, epoch: int) -> Iterator[np.ndarray]:
+    def _iter_batches(self, epoch: int, start: int = 0) -> Iterator[np.ndarray]:
         if self.streaming:
             rows = []
+            skipped = 0
             for chunk in self.dataset:
                 rows.append(chunk)
                 if len(rows) == self.batch_size:
-                    yield np.stack(rows)
+                    if skipped < start:
+                        skipped += 1  # resume fast-forward: discard
+                    else:
+                        yield np.stack(rows)
                     rows = []
-            if rows and not self.drop_last:
+            if rows and not self.drop_last and skipped >= start:
                 yield np.stack(rows)
         else:
             n = len(self.dataset)
@@ -440,7 +496,7 @@ class TextDataLoader:
             order = order[: (n // stride) * stride]
             local = order[self.process_index :: self.process_count]
             n_batches = len(local) // self.batch_size
-            for b in range(n_batches):
+            for b in range(start, n_batches):
                 idx = local[b * self.batch_size : (b + 1) * self.batch_size]
                 yield np.stack([self.dataset[i] for i in idx])
 

@@ -94,7 +94,7 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
     shard's mesh coordinates into the PRNG key (the kernel's counter-based
     mask hashes *local* positions, which coincide across shards).
     """
-    from jax import shard_map
+    from tpu_trainer.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpu_trainer.parallel.mesh import (
@@ -148,12 +148,17 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
         used_axes.update(b_spec)
     if h_spec is not None:
         used_axes.add(h_spec)
-    from jax.sharding import get_abstract_mesh
+    try:
+        from jax.sharding import get_abstract_mesh
+    except ImportError:  # old jax: no abstract meshes — trace on the
+        get_abstract_mesh = None  # concrete mesh as before
 
-    ctx_mesh = get_abstract_mesh()
     sm_mesh = mesh
-    if getattr(ctx_mesh, "shape_tuple", ()) and ctx_mesh.shape == mesh.shape:
-        sm_mesh = ctx_mesh
+    if get_abstract_mesh is not None:
+        ctx_mesh = get_abstract_mesh()
+        if (getattr(ctx_mesh, "shape_tuple", ())
+                and ctx_mesh.shape == mesh.shape):
+            sm_mesh = ctx_mesh
     fn = shard_map(
         local,
         mesh=sm_mesh,

@@ -236,7 +236,7 @@ def _fwd_parts(emb, x, labels, mask, mesh, interpret):
     if b_axes is None and s_axes is None:
         logits_t, lse, ll = local(x, e_c, labels)
     else:
-        from jax import shard_map
+        from tpu_trainer.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         # Partial-manual over the batch (and, round 5, sequence) axes only

@@ -456,7 +456,7 @@ def _tp_loss(emb, x, shifted, mask, mesh, chunk_size):
     replicated-input cotangent rule psums the partial dx exactly once,
     and the all-to-all transposes back to the h-sharded dE on its own.
     """
-    from jax import shard_map
+    from tpu_trainer.utils.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpu_trainer.parallel.mesh import TENSOR_AXIS
@@ -534,13 +534,20 @@ def fused_shifted_cross_entropy(
         from tpu_trainer.ops.head_ce import pallas_head_ce
 
         return pallas_head_ce(emb, x, shifted, mask, mesh, False)
+    from tpu_trainer.utils.jax_compat import PARTIAL_MANUAL_OK
+
     if (mesh is not None and mesh.shape.get("tensor", 1) > 1
             and mesh.shape.get("stage", 1) == 1
             # The h-slice -> vocab-slice all_to_all needs H divisible by
             # the axis; indivisible H keeps the embedding replicated under
             # the TP rules (sharding.py _tensor_dim) and the blockwise
             # path below handles it as before.
-            and emb.shape[1] % mesh.shape["tensor"] == 0):
+            and emb.shape[1] % mesh.shape["tensor"] == 0
+            # Old-jax ``auto=`` shard_map aborts the SPMD partitioner on
+            # this composition; the blockwise path below is the same math
+            # under pure GSPMD (partial logits + all-reduce), just without
+            # the vocab-slice memory optimization.
+            and PARTIAL_MANUAL_OK):
         return _tp_loss(emb, x, shifted, mask, mesh, chunk_size)
     chunk = _chunk_len(b, s, chunk_size)
     return _chunked_ce(emb, x, shifted, mask, chunk)

@@ -46,7 +46,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from tpu_trainer.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 SEQ_AXIS = "sequence"

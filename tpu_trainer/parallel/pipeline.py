@@ -41,7 +41,9 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from tpu_trainer.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_trainer.parallel.mesh import STAGE_AXIS

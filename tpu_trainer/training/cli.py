@@ -40,7 +40,7 @@ from tpu_trainer.parallel import mesh as mesh_lib
 from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.training.trainer import ParallelConfig, Trainer
 from tpu_trainer.utils import checkpoint as ckpt_lib
-from tpu_trainer.utils import guards, profiling
+from tpu_trainer.utils import faults, guards, profiling
 from tpu_trainer.utils.logging import MetricLogger
 
 # Steps between cross-host preemption votes (each vote is a collective, so
@@ -122,6 +122,26 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--resume_from", type=str, default=None)
     p.add_argument("--no_auto_resume", action="store_true", default=None)
+    p.add_argument("--keep_last_n", type=int, default=None,
+                   help="checkpoint GC: keep only the newest N completed "
+                        "checkpoints (0 = keep all)")
+    # fault tolerance (divergence rollback; utils/checkpoint.py hardening)
+    p.add_argument("--max_rollbacks", type=int, default=None,
+                   help="on a non-finite loss or cross-host divergence, "
+                        "rewind to the last good checkpoint and retry up to "
+                        "this many times before failing (0 = crash at once)")
+    p.add_argument("--skip_batches_on_rollback", type=int, default=None,
+                   help="on rollback, fast-forward the data stream this many "
+                        "batches past the batch that diverged (0 = replay "
+                        "the same data and rely on the LR backoff)")
+    p.add_argument("--rollback_lr_backoff", type=float, default=None,
+                   help="multiply the peak LR by this factor on each "
+                        "rollback (1.0 disables the backoff)")
+    p.add_argument("--inject_fault", type=str, default=None,
+                   help="debug: deterministic fault injection, "
+                        "'kind@step[,kind@step...]' — kinds: nan_loss, kill, "
+                        "kill_in_save, truncate_meta, corrupt_shard "
+                        "(utils/faults.py)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--wandb_project", type=str, default=None,
                    help="log metrics to Weights & Biases (import-guarded)")
@@ -236,6 +256,7 @@ def resolve_configs(args, mode: str):
     y_fsdp = y.get("fsdp", {}) or {}
     y_data = y.get("data", {}) or {}
     y_ckpt = y.get("checkpoint", {}) or {}
+    y_ft = y.get("fault_tolerance", {}) or {}
 
     # --- model ---------------------------------------------------------
     preset = _pick(args.model_size, _preset_from_name(y_model.get("name")), "small")
@@ -388,6 +409,18 @@ def resolve_configs(args, mode: str):
         "profile_start": _pick(args.profile_start, 5),
         "profile_steps": _pick(args.profile_steps, 5),
         "guard_interval": _pick(args.guard_interval, 100),
+        # Fault tolerance (YAML: checkpoint.keep_last_n + fault_tolerance.*).
+        # Defaults favor surviving a multi-day run: two rollbacks with
+        # half-LR backoff, skipping one batch past the offending window.
+        "keep_last_n": _picki(args.keep_last_n, y_ckpt.get("keep_last_n"), 0),
+        "max_rollbacks": _picki(args.max_rollbacks,
+                                y_ft.get("max_rollbacks"), 2),
+        "skip_batches_on_rollback": _picki(
+            args.skip_batches_on_rollback,
+            y_ft.get("skip_batches_on_rollback"), 1),
+        "rollback_lr_backoff": _pickf(args.rollback_lr_backoff,
+                                      y_ft.get("rollback_lr_backoff"), 0.5),
+        "inject_fault": args.inject_fault,
     }
     return model_config, training_config, parallel_config, data_opts
 
@@ -497,21 +530,50 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                   f"optimizer moments device-resident (exact f32), "
                   f"overflow streams to host")
 
+    # --- fault injection (--inject_fault debug flag; utils/faults.py) --
+    installed_plan = None
+    if data_opts["inject_fault"]:
+        installed_plan = faults.install(data_opts["inject_fault"])
+
     # --- resume (SURVEY.md §5.3: actually wired) -----------------------
     state = None
     tokens_seen = 0
+    data_state = None
     resume_path = training_config.resume_from
-    if resume_path is None and data_opts["auto_resume"]:
-        resume_path = ckpt_lib.latest_checkpoint(training_config.checkpoint_dir)
     if resume_path:
+        # Explicit --resume_from: failures raise — the user asked for this
+        # exact checkpoint, silently substituting another would be worse.
         state, meta = ckpt_lib.restore_checkpoint(resume_path, trainer)
         tokens_seen = meta.get("tokens_seen", 0)
+        data_state = meta.get("data_state")
         if main:
             print(f"resumed from {resume_path} at step {int(state.step)}")
-    else:
+    elif data_opts["auto_resume"]:
+        # Auto-resume hardening: a corrupt/partial latest checkpoint is
+        # quarantined and the previous valid step restores instead — one
+        # bad save must never brick the restart loop of a multi-day run.
+        restored = ckpt_lib.restore_latest(
+            training_config.checkpoint_dir, trainer, verify=True
+        )
+        if restored is not None:
+            state, meta, resume_path = restored
+            tokens_seen = meta.get("tokens_seen", 0)
+            data_state = meta.get("data_state")
+            if main:
+                print(f"resumed from {resume_path} at step {int(state.step)}")
+    if state is None:
         state = trainer.init_state()
 
     train_loader, eval_loader = build_dataloaders(data_opts, trainer, model_config)
+    if data_state is not None and hasattr(train_loader, "load_state_dict"):
+        # Exact data resume: continue at the consumed-batch cursor saved in
+        # the checkpoint instead of re-reading the dataset from the start.
+        try:
+            train_loader.load_state_dict(data_state)
+        except ValueError as e:
+            if main:
+                print(f"data state not restored ({e}); reading the dataset "
+                      f"from the start", flush=True)
 
     logger = MetricLogger(
         model_config,
@@ -537,10 +599,14 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
 
     def save(tag: str = ""):
+        data_sd = (train_loader.state_dict()
+                   if hasattr(train_loader, "state_dict") else None)
         path = ckpt_lib.save_checkpoint(
             training_config.checkpoint_dir, state,
             model_config=model_config, training_config=training_config,
             tokens_seen=logger.tokens_seen,
+            data_state=data_sd,
+            keep_last_n=data_opts["keep_last_n"],
         )
         if main:
             print(f"saved checkpoint{' (' + tag + ')' if tag else ''}: {path}")
@@ -594,47 +660,132 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     )
     guard_interval = data_opts["guard_interval"]
 
-    start_step = int(state.step)
-    step = start_step
+    # Rollback budget (divergence recovery): on a non-finite loss or
+    # cross-host divergence, rewind to the last good checkpoint, skip the
+    # offending data window, shrink the LR, and retry — bounded by
+    # --max_rollbacks so a deterministic failure still fails loudly.
+    max_rollbacks = data_opts["max_rollbacks"]
+    rollbacks = 0
+    steps_this_run = 0
+    base_lr = training_config.learning_rate
+
     try:
-        for step in range(start_step, training_config.max_steps):
-            profiler.step(step)
-            batch = next_batch()
-            state, metrics = trainer.train_step(state, batch)
-            record = logger.log(step, metrics)
-            if guard_interval and (step + 1) % guard_interval == 0:
-                loss = (record or {}).get("loss", float(metrics["loss"]))
-                guards.check_finite(step, loss)
-                guards.check_hosts_in_sync(step, loss)
-            eval_now = (training_config.eval_interval > 0
-                        and (step + 1) % training_config.eval_interval == 0)
-            if eval_now:
-                run_eval()
-            if (training_config.save_interval > 0
-                    and (step + 1) % training_config.save_interval == 0):
-                save()
-            # The preempt decision must be unanimous: the checkpoint save is
-            # a collective, so one host's SIGTERM pulls every host in. The
-            # cross-host vote is itself a collective, so on pods it runs at a
-            # fixed cadence every host hits at the same step (not on the
-            # local flag, which would desynchronize the allgather).
-            vote_now = (trainer.process_count == 1
-                        or (step + 1) % _PREEMPT_VOTE_INTERVAL == 0)
-            if vote_now and mesh_lib.global_any(preempted["hit"]):
+        while True:
+            try:
+                start_step = int(state.step)
+                step = start_step
+                for step in range(start_step, training_config.max_steps):
+                    if faults.fire("kill", step):
+                        faults.kill()
+                    profiler.step(step)
+                    batch = next_batch()
+                    state, metrics = trainer.train_step(state, batch)
+                    steps_this_run += 1
+                    if faults.fire("nan_loss", step):
+                        metrics = dict(metrics)
+                        metrics["loss"] = float("nan")
+                    record = logger.log(step, metrics)
+                    if guard_interval and (step + 1) % guard_interval == 0:
+                        loss = (record or {}).get("loss", float(metrics["loss"]))
+                        guards.check_finite(step, loss)
+                        guards.check_hosts_in_sync(step, loss)
+                    eval_now = (training_config.eval_interval > 0
+                                and (step + 1) % training_config.eval_interval == 0)
+                    if eval_now:
+                        run_eval()
+                    if (training_config.save_interval > 0
+                            and (step + 1) % training_config.save_interval == 0):
+                        save()
+                    # The preempt decision must be unanimous: the checkpoint
+                    # save is a collective, so one host's SIGTERM pulls every
+                    # host in. The cross-host vote is itself a collective, so
+                    # on pods it runs at a fixed cadence every host hits at
+                    # the same step (not on the local flag, which would
+                    # desynchronize the allgather).
+                    vote_now = (trainer.process_count == 1
+                                or (step + 1) % _PREEMPT_VOTE_INTERVAL == 0)
+                    if vote_now and mesh_lib.global_any(preempted["hit"]):
+                        if main:
+                            print("SIGTERM received: checkpointing and exiting")
+                        save("preempt")
+                        return 143
+                save("final")
+                if not (training_config.eval_interval > 0
+                        and step + 1 == training_config.max_steps
+                        and (step + 1) % training_config.eval_interval == 0):
+                    run_eval()  # skip only when the last step just ran eval
+                break
+            except (FloatingPointError, guards.DivergenceError) as err:
+                if rollbacks >= max_rollbacks:
+                    if main:
+                        print(f"divergence persisted after {rollbacks} "
+                              f"rollback(s); giving up", flush=True)
+                    raise
+                # The cursor at failure points just past the offending batch;
+                # capture it before the restore below rewinds the loader.
+                failure_cursor = (train_loader.state_dict()
+                                  if hasattr(train_loader, "state_dict")
+                                  else None)
+                rollbacks += 1
+                backoff = data_opts["rollback_lr_backoff"] ** rollbacks
+                if backoff != 1.0:
+                    # The LR schedule is traced into the jitted step as a
+                    # pure function of the config, so backing off means
+                    # rebuilding the trainer (a recompile — acceptable for
+                    # an event this rare).
+                    training_config = dataclasses.replace(
+                        training_config, learning_rate=base_lr * backoff)
+                    trainer = Trainer(model_config, training_config,
+                                      parallel_config)
+                restored = ckpt_lib.restore_latest(
+                    training_config.checkpoint_dir, trainer, verify=True)
+                if restored is None:
+                    if main:
+                        print("rollback impossible: no valid checkpoint to "
+                              "rewind to", flush=True)
+                    raise
+                state, meta, ckpt_path = restored
+                logger.tokens_seen = meta.get("tokens_seen", 0)
+                skip = data_opts["skip_batches_on_rollback"]
+                if hasattr(train_loader, "load_state_dict"):
+                    if skip > 0 and failure_cursor is not None:
+                        # Resume the data just past the diverging batch
+                        # (failure cursor - 1 + skip) instead of replaying it.
+                        cursor = dict(failure_cursor)
+                        cursor["batch_index"] += skip - 1
+                        train_loader.load_state_dict(cursor)
+                    elif meta.get("data_state") is not None:
+                        train_loader.load_state_dict(meta["data_state"])
+                if hasattr(data_iter, "close"):
+                    data_iter.close()
+                data_iter = iter(train_loader)
                 if main:
-                    print("SIGTERM received: checkpointing and exiting")
-                save("preempt")
-                return 143
-        save("final")
-        if not (training_config.eval_interval > 0
-                and step + 1 == training_config.max_steps
-                and (step + 1) % training_config.eval_interval == 0):
-            run_eval()  # skip only when the loop's last step just ran eval
+                    print(f"rollback {rollbacks}/{max_rollbacks}: "
+                          f"{type(err).__name__} at step {step}; rewound to "
+                          f"{ckpt_path} (step {int(state.step)}), lr x "
+                          f"{backoff:g}, skipping {skip} batch(es)",
+                          flush=True)
+    except (FloatingPointError, guards.DivergenceError):
+        raise  # poisoned state: never crash-save it
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        # Best-effort crash checkpoint: only after real progress this run
+        # (an immediate failure would just overwrite good state with noise).
+        if steps_this_run >= 1:
+            try:
+                save("crash")
+            except Exception as save_err:
+                if main:
+                    print(f"crash checkpoint failed: {save_err}", flush=True)
+        raise
     finally:
         signal.signal(signal.SIGTERM, old_handler)
         profiler.close()
         logger.close()
+        if installed_plan is not None:
+            faults.clear()
     if main:
-        print(f"done: {step + 1 - start_step} steps this run, "
+        print(f"done: {steps_this_run} steps this run, "
               f"{logger.tokens_seen:,} tokens total")
     return 0

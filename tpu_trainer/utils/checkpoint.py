@@ -18,7 +18,19 @@ for inference elsewhere" use the reference's pickle served.
 Layout::
 
     <dir>/step_00000100/state/   # orbax pytree of TrainState
-    <dir>/step_00000100/meta.json  # step, tokens_seen, model/training configs
+    <dir>/step_00000100/meta.json  # step, tokens_seen, configs, data_state
+
+Crash-safety contract (the fault-tolerance layer in ``training/cli.py``
+builds on all three):
+
+- A checkpoint is *complete* iff its meta.json parses: meta is written by
+  host 0 after every shard landed, so a crash mid-save leaves a directory
+  that ``latest_checkpoint``/``list_checkpoints`` simply never report.
+- ``restore_latest(verify=True)`` quarantines a checkpoint that fails to
+  load (corrupt shards, truncated meta) by renaming it aside and falls
+  back to the previous valid step instead of bricking auto-resume.
+- ``keep_last_n`` garbage-collects completed checkpoints oldest-first;
+  in-flight (meta-less) and quarantined directories are never touched.
 """
 
 from __future__ import annotations
@@ -27,7 +39,9 @@ import dataclasses
 import json
 import os
 import re
-from typing import Any, Optional, Tuple
+import shutil
+import sys
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,26 +50,103 @@ import orbax.checkpoint as ocp
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.parallel.mesh import barrier
 from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.utils import faults
 
 _STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
+
+# Suffix a failed-to-load checkpoint directory is renamed to. Quarantined
+# dirs no longer match _STEP_DIR_RE, so every scan ignores them; they are
+# kept on disk for postmortem rather than deleted.
+QUARANTINE_SUFFIX = ".corrupt"
+
+
+class CheckpointIncompatibleError(ValueError):
+    """The checkpoint loaded fine but belongs to a different run
+    configuration (model shapes, optimizer state dtype). Distinguished from
+    corruption: ``restore_latest`` quarantines corrupt checkpoints and falls
+    back, but a config mismatch is a user error that silently skipping
+    would turn into a fresh-start-over-hours-of-progress."""
 
 
 def step_dir(checkpoint_dir: str, step: int) -> str:
     return os.path.join(os.path.abspath(checkpoint_dir), f"step_{step:08d}")
 
 
-def latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
-    """Newest step_XXXXXXXX subdirectory, or None."""
+def _read_meta(path: str) -> Optional[dict]:
+    """meta.json of a step dir, or None if missing/empty/torn — an
+    unreadable meta means an incomplete or corrupt save and must never
+    crash a directory scan (a truncated meta.json used to brick
+    auto-resume with JSONDecodeError)."""
+    try:
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return meta if isinstance(meta, dict) else None
+
+
+def list_checkpoints(checkpoint_dir: str) -> List[Tuple[int, str]]:
+    """Completed checkpoints as ascending ``(step, path)`` pairs.
+
+    Completed = the directory name matches ``step_XXXXXXXX`` and its
+    meta.json parses. Meta-less directories (in-flight or crashed saves)
+    and quarantined ``*.corrupt`` directories are excluded.
+    """
     checkpoint_dir = os.path.abspath(checkpoint_dir)
     if not os.path.isdir(checkpoint_dir):
-        return None
-    best = None
-    for name in os.listdir(checkpoint_dir):
+        return []
+    out = []
+    for name in sorted(os.listdir(checkpoint_dir)):
         m = _STEP_DIR_RE.match(name)
-        if m and os.path.exists(os.path.join(checkpoint_dir, name, "meta.json")):
-            if best is None or int(m.group(1)) > int(best[0]):
-                best = (m.group(1), name)
-    return os.path.join(checkpoint_dir, best[1]) if best else None
+        if not m:
+            continue
+        path = os.path.join(checkpoint_dir, name)
+        if _read_meta(path) is not None:
+            out.append((int(m.group(1)), path))
+    return out
+
+
+def latest_checkpoint(checkpoint_dir: str) -> Optional[str]:
+    """Newest *readable* step_XXXXXXXX subdirectory, or None. A step dir
+    whose meta.json exists but is empty/truncated is skipped and the scan
+    keeps looking at older steps."""
+    ckpts = list_checkpoints(checkpoint_dir)
+    return ckpts[-1][1] if ckpts else None
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Move a bad checkpoint aside (rename, host 0) so scans stop seeing it;
+    returns the quarantine path. Collision-suffixed so repeated corruption
+    of the same step never throws."""
+    path = os.path.abspath(path)
+    dest = path + QUARANTINE_SUFFIX
+    n = 1
+    while os.path.exists(dest):
+        dest = f"{path}{QUARANTINE_SUFFIX}.{n}"
+        n += 1
+    if jax.process_index() == 0:
+        os.rename(path, dest)
+    barrier("checkpoint_quarantine")
+    return dest
+
+
+def gc_checkpoints(checkpoint_dir: str, keep_last_n: int) -> List[str]:
+    """Delete completed checkpoints beyond the newest ``keep_last_n``.
+
+    Only completed checkpoints count toward (and are eligible for) the
+    budget: an in-flight save's meta-less directory and quarantined dirs
+    are never touched. Returns the deleted paths.
+    """
+    if keep_last_n <= 0:
+        return []
+    removed = []
+    if jax.process_index() == 0:
+        complete = list_checkpoints(checkpoint_dir)
+        for _, path in complete[:-keep_last_n]:
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+    barrier("checkpoint_gc")
+    return removed
 
 
 def save_checkpoint(
@@ -65,6 +156,8 @@ def save_checkpoint(
     model_config: GPTConfig,
     training_config: TrainingConfig,
     tokens_seen: int = 0,
+    data_state: Optional[dict] = None,
+    keep_last_n: int = 0,
 ) -> str:
     """Write a sharded checkpoint; returns its path.
 
@@ -72,8 +165,14 @@ def save_checkpoint(
     meta.json is written by host 0 last, so a checkpoint without meta.json is
     incomplete and ignored by ``latest_checkpoint`` — the barrier-free
     analogue of the reference's save-then-barrier (``fsdp_trainer.py:465``).
+
+    ``data_state`` (a loader ``state_dict()``) rides along in meta.json so a
+    resumed run continues the data stream bit-exactly instead of re-reading
+    the dataset head. ``keep_last_n > 0`` garbage-collects older completed
+    checkpoints after this save lands.
     """
-    path = step_dir(checkpoint_dir, int(state.step))
+    step = int(state.step)
+    path = step_dir(checkpoint_dir, step)
     if getattr(state, "params_c", None) is not None:
         # Derived data (the compute-dtype param copy): stripping it keeps
         # the on-disk format identical to pre-carry checkpoints and saves
@@ -83,17 +182,41 @@ def save_checkpoint(
     ckptr.save(os.path.join(path, "state"), state, force=True)
     ckptr.wait_until_finished()
     barrier("checkpoint_save")
+    if faults.fire("kill_in_save", step):
+        # Injected crash between the shard writes and the meta write: the
+        # exact partial state a mid-save preemption leaves behind.
+        faults.kill()
     if jax.process_index() == 0:
         meta = {
-            "step": int(state.step),
+            "step": step,
             "tokens_seen": int(tokens_seen),
             "model_config": dataclasses.asdict(model_config),
             "training_config": dataclasses.asdict(training_config),
         }
+        if data_state is not None:
+            meta["data_state"] = data_state
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
     barrier("checkpoint_meta")
+    if faults.fire("truncate_meta", step):
+        faults.truncate_file(os.path.join(path, "meta.json"))
+    if faults.fire("corrupt_shard", step):
+        _corrupt_some_shard(path)
+    if keep_last_n > 0:
+        gc_checkpoints(checkpoint_dir, keep_last_n)
     return path
+
+
+def _corrupt_some_shard(path: str) -> None:
+    """Byte-flip every file under <path>/state — the injected version of
+    storage corruption (driven by the corrupt_shard fault). All files, not
+    a sample: tensorstore does not checksum every byte it reads back, so
+    flipping one data chunk can restore "successfully" as garbage — the
+    fault must deterministically fail the restore for the quarantine path
+    to be testable."""
+    for root, _, names in os.walk(os.path.join(path, "state")):
+        for name in names:
+            faults.corrupt_file(os.path.join(root, name))
 
 
 def load_meta(path: str) -> dict:
@@ -147,7 +270,7 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
                 k for k in set(saved_cfg) | set(now)
                 if saved_cfg.get(k) != now.get(k)
             )
-            raise ValueError(
+            raise CheckpointIncompatibleError(
                 f"checkpoint {path} holds an incompatible model "
                 f"(differing config fields: {', '.join(diff) or 'shapes'}); "
                 f"point --checkpoint_dir at a fresh directory, pass "
@@ -160,7 +283,7 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
     saved_osd = saved_tc.get("optimizer_state_dtype", "float32")
     now_osd = trainer.training_config.optimizer_state_dtype
     if saved_osd != now_osd:
-        raise ValueError(
+        raise CheckpointIncompatibleError(
             f"checkpoint {path} was saved with optimizer_state_dtype="
             f"{saved_osd!r} but this run uses {now_osd!r}; pass "
             f"--optimizer_state_dtype {saved_osd} to resume it"
@@ -178,6 +301,41 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
     )
     state = ocp.StandardCheckpointer().restore(os.path.join(path, "state"), abstract)
     return trainer.with_params_c(state), meta
+
+
+def restore_latest(
+    checkpoint_dir: str,
+    trainer,
+    *,
+    verify: bool = True,
+) -> Optional[Tuple[Any, dict, str]]:
+    """Restore the newest loadable checkpoint; ``(state, meta, path)`` or
+    ``None`` when the directory holds no completed checkpoint.
+
+    With ``verify=True`` (the auto-resume path), a checkpoint that fails to
+    load — corrupt shards, torn files, a meta.json that parses but lies —
+    is quarantined (renamed ``*.corrupt``) and the scan falls back to the
+    previous valid step, so one bad save never bricks a multi-day run.
+    ``CheckpointIncompatibleError`` (config mismatch, a user error) always
+    propagates: silently skipping it would restart training from step 0.
+    """
+    for _, path in reversed(list_checkpoints(checkpoint_dir)):
+        try:
+            state, meta = restore_checkpoint(path, trainer)
+            return state, meta, path
+        except CheckpointIncompatibleError:
+            raise
+        except Exception as e:
+            if not verify:
+                raise
+            dest = quarantine_checkpoint(path)
+            print(
+                f"checkpoint {path} failed to load "
+                f"({type(e).__name__}: {e}); quarantined to {dest}, "
+                f"falling back to the previous step",
+                file=sys.stderr, flush=True,
+            )
+    return None
 
 
 def restore_params(path: str):
@@ -206,10 +364,26 @@ def restore_params(path: str):
     )
     # Partial restore: only the params subtree is read — an xl inference load
     # must not pull the (2x param-sized) Adam moments off disk.
-    restored = ocp.PyTreeCheckpointer().restore(
-        os.path.join(path, "state"),
-        args=ocp.args.PyTreeRestore(item={"params": abstract}, partial_restore=True),
-    )
+    try:
+        args = ocp.args.PyTreeRestore(
+            item={"params": abstract}, partial_restore=True
+        )
+    except TypeError:
+        # Pre-partial_restore orbax (<= 0.7): the legacy transforms API
+        # spells the same thing as "restore item's keys only", but then
+        # insists on explicit per-leaf restore_args.
+        restore_args = jax.tree_util.tree_map(
+            lambda s: ocp.ArrayRestoreArgs(
+                sharding=sharding, dtype=s.dtype, global_shape=s.shape
+            ),
+            shapes,
+        )
+        args = ocp.args.PyTreeRestore(
+            item={"params": abstract}, transforms={},
+            restore_args={"params": restore_args},
+        )
+    restored = ocp.PyTreeCheckpointer().restore(os.path.join(path, "state"),
+                                                args=args)
     return restored["params"], config
 
 

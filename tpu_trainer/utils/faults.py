@@ -1,0 +1,157 @@
+"""Deterministic fault injection for crash-safety testing.
+
+The fault-tolerance layer (exact data resume, divergence rollback,
+checkpoint quarantine — see ``training/cli.py`` and ``utils/checkpoint.py``)
+is only trustworthy if the failures it guards against can be produced on
+demand. This module injects them at exact step numbers, driven either
+programmatically (tests) or from the ``--inject_fault`` debug flag:
+
+- ``nan_loss@N``      — report a NaN loss for step N (exercises the
+  divergence-rollback loop without needing real numeric blowup).
+- ``kill@N``          — hard-kill the process (``os._exit``) at the top of
+  step N, before the step runs (a preemption that outran SIGTERM).
+- ``kill_in_save@N``  — hard-kill *mid-checkpoint-save* at step N: after
+  the state shards are written, before meta.json — leaving exactly the
+  partial checkpoint a real crash leaves.
+- ``truncate_meta@N`` — truncate the meta.json of the step-N checkpoint
+  right after it is written (a torn metadata write).
+- ``corrupt_shard@N`` — flip bytes in a state shard of the step-N
+  checkpoint after the save completes (silent storage corruption).
+
+Each fault is one-shot: it fires at its step and is consumed, so a run that
+rolls back or resumes past the step does not re-trip it — which is exactly
+the recoverable-transient-failure model the rollback loop targets.
+
+Faults install into process-global state (``install``/``clear``) because
+the injection points are deep inside the checkpoint writer and the step
+loop; tests must ``clear()`` in teardown (or use ``plan()`` as a context
+manager).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import sys
+from typing import List, Optional, Tuple
+
+KINDS = frozenset(
+    {"nan_loss", "kill", "kill_in_save", "truncate_meta", "corrupt_shard"}
+)
+
+# Exit code for injected kills: mimics SIGKILL's 128+9, the way a preempted
+# or OOM-killed trainer actually dies.
+KILL_EXIT_CODE = 137
+
+_SPEC_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)$")
+
+
+class FaultPlan:
+    """An ordered set of one-shot ``(kind, step)`` faults."""
+
+    def __init__(self, faults: List[Tuple[str, int]]):
+        for kind, step in faults:
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; choose from "
+                    f"{sorted(KINDS)}"
+                )
+            if step < 0:
+                raise ValueError(f"fault step must be >= 0, got {step}")
+        self._pending = list(faults)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse ``"kind@step[,kind@step...]"`` (the --inject_fault syntax)."""
+        faults = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            m = _SPEC_RE.match(part)
+            if not m:
+                raise ValueError(
+                    f"bad fault spec {part!r}; expected kind@step, e.g. "
+                    f"nan_loss@25 (kinds: {sorted(KINDS)})"
+                )
+            faults.append((m.group("kind"), int(m.group("step"))))
+        if not faults:
+            raise ValueError(f"empty fault spec {spec!r}")
+        return cls(faults)
+
+    def fire(self, kind: str, step: int) -> bool:
+        """True (and consume the fault) if ``kind`` is armed for ``step``."""
+        key = (kind, int(step))
+        if key in self._pending:
+            self._pending.remove(key)
+            return True
+        return False
+
+    def pending(self) -> List[Tuple[str, int]]:
+        return list(self._pending)
+
+
+_active: Optional[FaultPlan] = None
+
+
+def install(spec_or_plan) -> FaultPlan:
+    """Arm a fault plan process-wide (spec string or FaultPlan)."""
+    global _active
+    _active = (spec_or_plan if isinstance(spec_or_plan, FaultPlan)
+               else FaultPlan.parse(spec_or_plan))
+    return _active
+
+
+def clear() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+@contextlib.contextmanager
+def plan(spec_or_plan):
+    """``with faults.plan("nan_loss@3"):`` — install, then always clear."""
+    install(spec_or_plan)
+    try:
+        yield _active
+    finally:
+        clear()
+
+
+def fire(kind: str, step: int) -> bool:
+    """Check-and-consume against the installed plan; no-op without one."""
+    return _active is not None and _active.fire(kind, step)
+
+
+def kill(exit_code: int = KILL_EXIT_CODE) -> None:
+    """Die the way a crash dies: no atexit, no finally, no flushing beyond
+    what has already reached the OS. (stdio is flushed first so the test
+    harness can still see the pre-crash log lines.)"""
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    finally:
+        os._exit(exit_code)
+
+
+def truncate_file(path: str) -> None:
+    """Simulate a torn write: the file exists but holds nothing."""
+    with open(path, "w"):
+        pass
+
+
+def corrupt_file(path: str, offset_fraction: float = 0.5) -> None:
+    """Flip bytes mid-file — silent storage corruption, size unchanged."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    pos = int(size * offset_fraction) % size
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        chunk = f.read(min(64, size - pos)) or b"\x00"
+        f.seek(pos)
+        f.write(bytes(b ^ 0xFF for b in chunk))
