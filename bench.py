@@ -146,7 +146,8 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     from tpu_trainer.parallel.mesh import make_mesh
     from tpu_trainer.training.config import TrainingConfig
     from tpu_trainer.training.trainer import ParallelConfig, Trainer
-    from tpu_trainer.utils.logging import memory_stats, mfu
+    from tpu_trainer.utils import telemetry as telemetry_lib
+    from tpu_trainer.utils.logging import flops_per_token, memory_stats, mfu
 
     mesh = make_mesh(mesh_cfg, devices=devices)
     on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
@@ -202,13 +203,15 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     )
     it = iter(loader)
 
+    ledger = telemetry_lib.GoodputLedger()
     state = trainer.init_state()
     # Warmup: compile + 2 steps (first step may still include autotuning).
     # Sync by fetching the loss — under the axon tunnel block_until_ready
     # does not actually block, but a host read of a chained result does.
-    for _ in range(2):
-        state, metrics = trainer.train_step(state, next(it))
-    float(metrics["loss"])
+    with ledger.track("compile"):
+        for _ in range(2):
+            state, metrics = trainer.train_step(state, next(it))
+        float(metrics["loss"])
 
     # Five measured windows, keep the fastest: the shared/tunneled chip
     # shows minutes-long contention spikes where wall clock runs up to 3x
@@ -221,9 +224,12 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(steps):
-            batch = next(it)
-            state, metrics = trainer.train_step(state, batch)
-        final_loss = float(metrics["loss"])  # end-of-window sync
+            with ledger.track("data_wait"):
+                batch = next(it)
+            with ledger.track("step"):
+                state, metrics = trainer.train_step(state, batch)
+        with ledger.track("step"):  # the device wait lands here
+            final_loss = float(metrics["loss"])  # end-of-window sync
         elapsed = min(elapsed, time.perf_counter() - t0)
 
     n_chips = mesh.size
@@ -245,6 +251,17 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         if ma is not None:
             peak_mem_gb = round(ma["peak_bytes"] / 2**30, 2)
             mem_source = "compiled"
+    # Predicted-vs-achieved FLOPs: the XLA cost model's count for the
+    # compiled step (executable-cache hit — no recompile) next to the
+    # analytic 6N+attention count at the ACTUAL seq_len, and the model
+    # FLOP/s the measured windows achieved.
+    try:
+        ca = trainer.step_cost_analysis(state, batch)
+    except Exception:
+        ca = None
+    analytic_flops_step = flops_per_token(model_config, seq_len) \
+        * trainer.tokens_per_step
+    goodput = ledger.record(final=True)
     return {
         "model_size": model_size,
         "params": model_config.num_parameters(),
@@ -266,10 +283,20 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         "elapsed_s": round(elapsed, 3),
         "tok_per_sec": round(tok_per_sec, 1),
         "tok_per_sec_per_chip": round(tok_per_sec / n_chips, 1),
-        "mfu": round(mfu(tok_per_sec, model_config), 4) if on_tpu else None,
+        # MFU against the attention term at the RUN's seq_len, not the
+        # model's max_seq_len (they already match here because the bench
+        # sets max_seq_len=seq_len, but keep the call honest).
+        "mfu": (round(mfu(tok_per_sec, model_config, seq_len=seq_len), 4)
+                if on_tpu else None),
         "peak_mem_gb": peak_mem_gb,
         "peak_mem_source": mem_source if peak_mem_gb is not None else None,
         "final_loss": final_loss,
+        "analytic_flops_per_step": analytic_flops_step,
+        "xla_flops_per_step": (ca or {}).get("flops_per_step"),
+        "achieved_model_flops_per_sec": round(
+            tok_per_sec * flops_per_token(model_config, seq_len), 1),
+        "goodput": {k: round(v, 4) if isinstance(v, float) else v
+                    for k, v in goodput.items() if k != "kind"},
     }
 
 
@@ -437,6 +464,11 @@ def main() -> None:
         "value": detail["tok_per_sec"],
         "unit": "tok/s",
         "vs_baseline": round(detail["tok_per_sec"] / _REF_BASELINE, 4),
+        # Additive observability fields (ISSUE 2): measured-loop goodput
+        # and XLA-predicted vs analytic FLOPs for the compiled step.
+        "goodput_productive_frac": detail["goodput"].get("productive_frac"),
+        "xla_flops_per_step": detail["xla_flops_per_step"],
+        "analytic_flops_per_step": detail["analytic_flops_per_step"],
     }
     # Side-channel detail (stderr keeps stdout to the single JSON line the
     # driver parses).

@@ -46,6 +46,7 @@ from tpu_trainer.ops.loss import (
     fused_shifted_cross_entropy,
     vocab_sharded_shifted_cross_entropy,
 )
+from tpu_trainer.utils import telemetry
 
 
 class RMSNorm(nn.Module):
@@ -392,10 +393,13 @@ class MLP(nn.Module):
 class TransformerBlock(nn.Module):
     """Pre-norm block with two residuals (reference ``gpt.py:286-316``).
 
-    Written in scan form: ``__call__(carry, _) -> (carry, None)`` so a single
+    Written in scan form: ``__call__(carry, _) -> (carry, ys)`` so a single
     traced block is iterated ``num_layers`` times by ``nn.scan``. The carry
     is ``(x, aux)`` — ``aux`` accumulates the MoE load-balance loss across
-    layers (zero for the dense model).
+    layers (zero for the dense model). ``ys`` is normally None; under an
+    active telemetry capture (utils/telemetry) it is a dict of per-layer
+    activation/router stats, which the scan stacks into ``[num_layers]``
+    vectors (the unrolled path stacks them by hand).
     """
 
     config: GPTConfig
@@ -411,6 +415,7 @@ class TransformerBlock(nn.Module):
         h = CausalSelfAttention(cfg, name="attention")(
             h, self.deterministic, self.decode
         )
+        attn_out = h
         x = residual + h
 
         residual = x
@@ -423,7 +428,23 @@ class TransformerBlock(nn.Module):
         else:
             h = MLP(cfg, name="mlp")(h, self.deterministic)
         x = residual + h
-        return (x, aux), None
+
+        telem = None
+        if telemetry.capturing():
+            telem = {
+                "attn_rms": telemetry.rms(attn_out),
+                "attn_absmax": telemetry.absmax(attn_out),
+                "ffn_rms": telemetry.rms(h),
+                "ffn_absmax": telemetry.absmax(h),
+                "block_rms": telemetry.rms(x),
+                "block_absmax": telemetry.absmax(x),
+            }
+            router = telemetry.pop("router")
+            if router is not None:
+                telem.update(
+                    {f"router_{k}": v for k, v in router.items()}
+                )
+        return (x, aux), telem
 
 
 @jax.custom_vjp
@@ -487,6 +508,10 @@ class GPT(nn.Module):
             name="embed_tokens",
         )
         x = embed(input_ids)
+        if telemetry.capturing():
+            telemetry.record("embed_out", {
+                "rms": telemetry.rms(x), "absmax": telemetry.absmax(x),
+            })
 
         policies = {
             "full": None,
@@ -509,7 +534,7 @@ class GPT(nn.Module):
 
             def run_block(p, carry, rng):
                 rngs = {} if rng is None else {"dropout": rng}
-                return block_mod.apply({"params": p}, carry, rngs=rngs)[0]
+                return block_mod.apply({"params": p}, carry, rngs=rngs)
 
             if cfg.gradient_checkpointing:
                 run_block = jax.checkpoint(
@@ -528,7 +553,10 @@ class GPT(nn.Module):
             from tpu_trainer.parallel.pipeline import pipeline_forward
 
             def block_fn(p, xm, rng=None):
-                return run_block(p, (xm, jnp.zeros((), jnp.float32)), rng)
+                # [0]: the pipeline schedule carries only (x, aux) between
+                # stages — per-layer telemetry ys are not collected here
+                # (the 1f1b variants bypass normal AD entirely).
+                return run_block(p, (xm, jnp.zeros((), jnp.float32)), rng)[0]
 
             rng = self.make_rng("dropout") if needs_rng else None
             # SP x PP: go jointly manual over {stage, sequence} so the
@@ -563,10 +591,18 @@ class GPT(nn.Module):
             # collection) and very deep models (compile time).
             per_layer = _unstack_layers(self.variables["params"]["layers"])
             carry = carry0
+            telems = []
             for p in per_layer:
                 rng = self.make_rng("dropout") if needs_rng else None
-                carry = run_block(p, carry, rng)
+                carry, telem = run_block(p, carry, rng)
+                if telem is not None:
+                    telems.append(telem)
             x, moe_aux = carry
+            if telems:
+                # Same [num_layers, ...] stacking nn.scan's ys would give.
+                telemetry.record("layers", jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *telems
+                ))
         else:
             block = TransformerBlock
             if cfg.gradient_checkpointing and not decode:
@@ -582,13 +618,29 @@ class GPT(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
             )
-            (x, moe_aux), _ = layers(
+            (x, moe_aux), layer_telem = layers(
                 cfg, deterministic=not train, decode=decode, name="layers"
             )(carry0, None)
+            if layer_telem is not None:
+                telemetry.record("layers", layer_telem)
 
         x = RMSNorm(dtype=cfg.compute_dtype, name="norm")(x)
+        if telemetry.capturing():
+            telemetry.record("final_norm", {
+                "rms": telemetry.rms(x), "absmax": telemetry.absmax(x),
+            })
         # Weight tying (reference gpt.py:342): logits via the embedding matrix.
         logits = embed.attend(x).astype(jnp.float32)
+        if telemetry.capturing(deep=True):
+            # Between final_norm and the loss, nan-scan only: making the
+            # logits live here would defeat the fused/remat loss heads'
+            # memory savings on periodic telemetry steps, but without this
+            # site a NaN entering in the head matmul is indistinguishable
+            # from one entering in the loss math (the seq x tensor repro,
+            # ROADMAP open items).
+            telemetry.record("logits", {
+                "rms": telemetry.rms(logits), "absmax": telemetry.absmax(logits),
+            })
 
         loss = None
         if labels is not None:

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.utils import telemetry
 
 
 # --- all-gather dispatch/combine (custom_vjp) ------------------------------
@@ -216,6 +217,18 @@ class MoEMLP(nn.Module):
 
         dtype = cfg.compute_dtype
         kept = jnp.sum(keep_k, axis=-1) > 0                     # [T, k]
+        if telemetry.capturing():
+            # Router health (Switch-Transformer diagnostics), popped by the
+            # enclosing TransformerBlock into its per-layer telemetry:
+            # first-choice load fractions (sum to 1 by construction),
+            # entropy of the mean routing distribution (log E when the
+            # router is uniform, 0 when it collapses onto one expert), and
+            # the fraction of token-choices dropped at capacity.
+            telemetry.record("router", {
+                "load": frac,
+                "entropy": -jnp.sum(mean_prob * jnp.log(mean_prob + 1e-9)),
+                "drop_frac": 1.0 - jnp.mean(kept.astype(jnp.float32)),
+            })
         mode = cfg.moe_dispatch
         if mode == "auto":
             # Trace-time mesh introspection: gathers are far cheaper on a

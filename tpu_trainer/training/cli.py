@@ -41,7 +41,8 @@ from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.training.trainer import ParallelConfig, Trainer
 from tpu_trainer.utils import checkpoint as ckpt_lib
 from tpu_trainer.utils import faults, guards, profiling
-from tpu_trainer.utils.logging import MetricLogger
+from tpu_trainer.utils import telemetry as telemetry_lib
+from tpu_trainer.utils.logging import MetricLogger, flops_per_token
 
 # Steps between cross-host preemption votes (each vote is a collective, so
 # it must run at a cadence every host reaches at the same step).
@@ -139,9 +140,9 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                         "rollback (1.0 disables the backoff)")
     p.add_argument("--inject_fault", type=str, default=None,
                    help="debug: deterministic fault injection, "
-                        "'kind@step[,kind@step...]' — kinds: nan_loss, kill, "
-                        "kill_in_save, truncate_meta, corrupt_shard "
-                        "(utils/faults.py)")
+                        "'kind@step[,kind@step...]' — kinds: nan_loss, "
+                        "loss_spike, kill, kill_in_save, truncate_meta, "
+                        "corrupt_shard (utils/faults.py)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--wandb_project", type=str, default=None,
                    help="log metrics to Weights & Biases (import-guarded)")
@@ -158,6 +159,20 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--guard_interval", type=int, default=None,
                    help="steps between finite-loss + cross-host sync checks "
                         "(default 100; 0 disables)")
+    # telemetry / goodput / early warning (utils/telemetry.py)
+    p.add_argument("--telemetry_interval", type=int, default=None,
+                   help="steps between in-graph telemetry steps (per-layer "
+                        "grad/param/update norms, activation RMS/absmax, MoE "
+                        "router health — a second compiled step variant, so "
+                        "steps in between pay nothing; default 0 = off)")
+    p.add_argument("--spike_sigma", type=float, default=None,
+                   help="loss-spike early warning: raise (and roll back) when "
+                        "the logged loss exceeds the rolling median by this "
+                        "many MAD-sigmas (default 6; 0 disables)")
+    p.add_argument("--nan_scan", action="store_true", default=None,
+                   help="debug: run one forward-only activation scan on the "
+                        "first batch, report the first layer/site with a "
+                        "non-finite value, and exit without training")
     # mesh / multi-host
     p.add_argument("--mesh_data", type=int, default=None)
     p.add_argument("--mesh_fsdp", type=int, default=None)
@@ -421,6 +436,10 @@ def resolve_configs(args, mode: str):
         "rollback_lr_backoff": _pickf(args.rollback_lr_backoff,
                                       y_ft.get("rollback_lr_backoff"), 0.5),
         "inject_fault": args.inject_fault,
+        # Telemetry / goodput / early warning (utils/telemetry.py).
+        "telemetry_interval": _picki(args.telemetry_interval, None, 0),
+        "spike_sigma": _pickf(args.spike_sigma, None, 6.0),
+        "nan_scan": bool(_pick(args.nan_scan, False)),
     }
     return model_config, training_config, parallel_config, data_opts
 
@@ -535,6 +554,9 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     if data_opts["inject_fault"]:
         installed_plan = faults.install(data_opts["inject_fault"])
 
+    # --- goodput ledger: attribute every second of the run -------------
+    ledger = telemetry_lib.GoodputLedger()
+
     # --- resume (SURVEY.md §5.3: actually wired) -----------------------
     state = None
     tokens_seen = 0
@@ -543,7 +565,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     if resume_path:
         # Explicit --resume_from: failures raise — the user asked for this
         # exact checkpoint, silently substituting another would be worse.
-        state, meta = ckpt_lib.restore_checkpoint(resume_path, trainer)
+        with ledger.track("checkpoint_restore"):
+            state, meta = ckpt_lib.restore_checkpoint(resume_path, trainer)
         tokens_seen = meta.get("tokens_seen", 0)
         data_state = meta.get("data_state")
         if main:
@@ -552,9 +575,10 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         # Auto-resume hardening: a corrupt/partial latest checkpoint is
         # quarantined and the previous valid step restores instead — one
         # bad save must never brick the restart loop of a multi-day run.
-        restored = ckpt_lib.restore_latest(
-            training_config.checkpoint_dir, trainer, verify=True
-        )
+        with ledger.track("checkpoint_restore"):
+            restored = ckpt_lib.restore_latest(
+                training_config.checkpoint_dir, trainer, verify=True
+            )
         if restored is not None:
             state, meta, resume_path = restored
             tokens_seen = meta.get("tokens_seen", 0)
@@ -587,8 +611,49 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             "model": dataclasses.asdict(model_config),
             "training": dataclasses.asdict(training_config),
         },
+        seq_len=training_config.max_seq_len,
     )
     logger.tokens_seen = tokens_seen
+
+    # --- nan_scan debug mode: bisect the first non-finite layer, exit --
+    if data_opts["nan_scan"]:
+        try:
+            batch = next(iter(train_loader))
+            report = trainer.nan_scan(state, batch)
+            first = report["first_nan"]
+            verdict = (
+                "no non-finite activations in the forward" if first is None
+                else f"first non-finite value at layer {first['layer']}, "
+                     f"site '{first['site']}'"
+            )
+            if main:
+                print(f"nan_scan | {verdict}")
+                stats = report["stats"]
+                layers = sorted({k.rsplit("/L", 1)[1]
+                                 for k in stats if "/L" in k})
+                for li in layers:
+                    row = " ".join(
+                        f"{site}={stats.get(f'nan_scan/act/{site}_absmax/L{li}', float('nan')):.3e}"
+                        for site in ("attn", "ffn", "block")
+                    )
+                    print(f"nan_scan | layer {li} absmax: {row}")
+                head = " ".join(
+                    f"{site}={stats[f'nan_scan/act/{site}_absmax']:.3e}"
+                    for site in ("embed_out", "final_norm", "logits")
+                    if f"nan_scan/act/{site}_absmax" in stats
+                )
+                print(f"nan_scan | head absmax: {head}")
+                print(f"nan_scan | loss: {stats['nan_scan/loss']:.6g}")
+            logger.log_record({
+                "kind": "nan_scan", "step": int(state.step),
+                "first_nan": first, "sites": report["sites"],
+                **report["stats"],
+            })
+            return 0
+        finally:
+            logger.close()
+            if installed_plan is not None:
+                faults.clear()
 
     # --- preemption handler (TPU maintenance SIGTERM) ------------------
     preempted = {"hit": False}
@@ -599,15 +664,16 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
 
     def save(tag: str = ""):
-        data_sd = (train_loader.state_dict()
-                   if hasattr(train_loader, "state_dict") else None)
-        path = ckpt_lib.save_checkpoint(
-            training_config.checkpoint_dir, state,
-            model_config=model_config, training_config=training_config,
-            tokens_seen=logger.tokens_seen,
-            data_state=data_sd,
-            keep_last_n=data_opts["keep_last_n"],
-        )
+        with ledger.track("checkpoint_save"):
+            data_sd = (train_loader.state_dict()
+                       if hasattr(train_loader, "state_dict") else None)
+            path = ckpt_lib.save_checkpoint(
+                training_config.checkpoint_dir, state,
+                model_config=model_config, training_config=training_config,
+                tokens_seen=logger.tokens_seen,
+                data_state=data_sd,
+                keep_last_n=data_opts["keep_last_n"],
+            )
         if main:
             print(f"saved checkpoint{' (' + tag + ')' if tag else ''}: {path}")
 
@@ -617,10 +683,11 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         if eval_loader is None:
             return
         losses = []
-        for i, batch in enumerate(eval_loader):
-            if i >= data_opts["eval_batches"]:
-                break
-            losses.append(float(trainer.eval_step(state, batch)))
+        with ledger.track("eval"):
+            for i, batch in enumerate(eval_loader):
+                if i >= data_opts["eval_batches"]:
+                    break
+                losses.append(float(trainer.eval_step(state, batch)))
         if losses and main:
             logger.log_eval(int(state.step), float(np.mean(losses)),
                             len(losses))
@@ -669,6 +736,21 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     steps_this_run = 0
     base_lr = training_config.learning_rate
 
+    # Telemetry cadence + loss-spike early warning (ISSUE 2). The spike
+    # check reads only records the logger actually emitted (``record is not
+    # None``) so steady-state steps never force a device sync.
+    telemetry_interval = data_opts["telemetry_interval"]
+    spike = (telemetry_lib.SpikeDetector(sigma=data_opts["spike_sigma"])
+             if data_opts["spike_sigma"] > 0 else None)
+    # Goodput attribution: the first execution of each jitted step variant
+    # pays tracing + XLA compilation, so its wall-clock goes to "compile";
+    # later executions go to "step" (or "rollback_replay" while re-covering
+    # ground rewound by a rollback). Reset on LR backoff — rebuilding the
+    # trainer recompiles both variants.
+    jit_warm = {"step": False, "telemetry": False}
+    cost_emitted = False
+    replay_until = -1   # steps <= this are rollback replay, not fresh work
+
     try:
         while True:
             try:
@@ -678,13 +760,74 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     if faults.fire("kill", step):
                         faults.kill()
                     profiler.step(step)
-                    batch = next_batch()
-                    state, metrics = trainer.train_step(state, batch)
-                    steps_this_run += 1
-                    if faults.fire("nan_loss", step):
-                        metrics = dict(metrics)
-                        metrics["loss"] = float("nan")
-                    record = logger.log(step, metrics)
+                    with ledger.track("data_wait"):
+                        batch = next_batch()
+                    tel_step = bool(telemetry_interval
+                                    and (step + 1) % telemetry_interval == 0)
+                    variant = "telemetry" if tel_step else "step"
+                    category = ("compile" if not jit_warm[variant]
+                                else "rollback_replay" if step <= replay_until
+                                else "step")
+                    # The logger's loss read is the device sync point, so it
+                    # stays inside the tracked block — otherwise async
+                    # dispatch would bank the real compute under "untracked".
+                    with ledger.track(category):
+                        state, metrics = trainer.train_step(
+                            state, batch, telemetry=tel_step)
+                        if not jit_warm[variant]:
+                            jax.block_until_ready(metrics["loss"])
+                            jit_warm[variant] = True
+                        steps_this_run += 1
+                        if faults.fire("nan_loss", step):
+                            metrics = dict(metrics)
+                            metrics["loss"] = float("nan")
+                        if faults.fire("loss_spike", step):
+                            # Large but finite: the early-warning path must
+                            # engage before anything trips the NaN guard.
+                            metrics = dict(metrics)
+                            metrics["loss"] = float(metrics["loss"]) * 8.0 + 5.0
+                        record = logger.log(step, metrics)
+                    if not cost_emitted:
+                        # One-time XLA cost model vs analytic FLOPs. Runs
+                        # after the first step so .lower().compile() hits the
+                        # executable cache instead of recompiling.
+                        cost_emitted = True
+                        cost = trainer.step_cost_analysis(state, batch)
+                        if cost is not None:
+                            analytic = (flops_per_token(
+                                model_config, training_config.max_seq_len)
+                                * trainer.tokens_per_step)
+                            rec = {"kind": "cost_analysis", "step": step}
+                            rec.update(cost)
+                            rec["analytic_flops_per_step"] = analytic
+                            lines = []
+                            if cost.get("flops_per_step"):
+                                rec["analytic_over_xla"] = (
+                                    analytic / cost["flops_per_step"])
+                                lines.append(
+                                    "cost_analysis | xla "
+                                    f"{cost['flops_per_step']:.3e} flops/step"
+                                    f" | analytic {analytic:.3e}"
+                                    f" (x{rec['analytic_over_xla']:.2f})")
+                            if cost.get("peak_bytes"):
+                                lines.append(
+                                    "cost_analysis | predicted peak HBM "
+                                    f"{cost['peak_bytes'] / 2**30:.2f} GiB")
+                            logger.log_record(rec, stdout_lines=lines)
+                    if spike is not None and record is not None:
+                        is_spike, z = spike.update(record["loss"])
+                        if is_spike:
+                            if main:
+                                print(
+                                    f"loss spike at step {step}: loss "
+                                    f"{record['loss']:.4f} is z={z:.1f} above "
+                                    f"the rolling median (sigma="
+                                    f"{data_opts['spike_sigma']:g}); rolling "
+                                    "back before divergence", flush=True)
+                            raise guards.LossSpikeError(
+                                f"loss spike (z={z:.1f}) at step {step}")
+                    if tel_step:
+                        logger.log_record(ledger.record(step=step))
                     if guard_interval and (step + 1) % guard_interval == 0:
                         loss = (record or {}).get("loss", float(metrics["loss"]))
                         guards.check_finite(step, loss)
@@ -737,8 +880,16 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                         training_config, learning_rate=base_lr * backoff)
                     trainer = Trainer(model_config, training_config,
                                       parallel_config)
-                restored = ckpt_lib.restore_latest(
-                    training_config.checkpoint_dir, trainer, verify=True)
+                    jit_warm = {"step": False, "telemetry": False}
+                if spike is not None:
+                    # The restored loss level predates the whole window;
+                    # stale history would re-fire on the first post-rollback
+                    # loss and burn the rollback budget.
+                    spike.reset()
+                replay_until = step  # re-covered ground is not fresh goodput
+                with ledger.track("checkpoint_restore"):
+                    restored = ckpt_lib.restore_latest(
+                        training_config.checkpoint_dir, trainer, verify=True)
                 if restored is None:
                     if main:
                         print("rollback impossible: no valid checkpoint to "
@@ -765,6 +916,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                           f"{ckpt_path} (step {int(state.step)}), lr x "
                           f"{backoff:g}, skipping {skip} batch(es)",
                           flush=True)
+        logger.log_record(ledger.record(step=int(state.step), final=True),
+                          stdout_lines=ledger.summary_lines())
     except (FloatingPointError, guards.DivergenceError):
         raise  # poisoned state: never crash-save it
     except (KeyboardInterrupt, SystemExit):

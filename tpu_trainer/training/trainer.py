@@ -29,6 +29,7 @@ Key mappings (SURVEY.md C9/C10/C15/C16):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, Optional, Tuple
@@ -48,6 +49,7 @@ from tpu_trainer.parallel import mesh as mesh_lib
 from tpu_trainer.parallel import sharding as shard_lib
 from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.training.optimizer import make_optimizer
+from tpu_trainer.utils import telemetry
 
 _MP_TO_DTYPE = {"fp32": "float32", "bf16": "bfloat16", "fp16": "float16"}
 
@@ -456,6 +458,17 @@ class Trainer:
             in_shardings=(self.state_shardings, self.batch_sharding),
             out_shardings=(self.state_shardings, None),
         )
+        # Telemetry step: a SECOND executable of the same math with the
+        # per-layer stats as extra outputs (utils/telemetry). The training
+        # loop calls it every --telemetry_interval steps; the steady-state
+        # step above keeps its original graph and pays nothing. jax.jit is
+        # lazy, so runs that never ask for telemetry never compile this.
+        self._step_tel_jit = jax.jit(
+            functools.partial(self._train_step, telemetry_on=True),
+            donate_argnums=(0,),
+            in_shardings=(self.state_shardings, self.batch_sharding),
+            out_shardings=(self.state_shardings, None),
+        )
         eval_batch_sharding = NamedSharding(
             self.mesh, mesh_lib.batch_spec_2d()
         )
@@ -676,14 +689,23 @@ class Trainer:
             batch = self.put_batch(batch)
         return batch
 
-    def train_step(self, state: TrainState, batch) -> Tuple[TrainState, dict]:
+    def train_step(self, state: TrainState, batch,
+                   telemetry: bool = False) -> Tuple[TrainState, dict]:
         """One optimizer step over ``accum`` micro-batches.
 
         ``batch``: the sharded ``[accum, global_bs, seq]`` device array from
         ``put_batch``, or a **process-local** host array, which is placed
         automatically (``_place_batch``).
+
+        ``telemetry=True`` runs the telemetry variant of the step (separate
+        executable, compiled on first use): the metrics dict gains a
+        ``"telemetry"`` subtree of per-layer grad/param/update norms,
+        activation RMS/absmax, and MoE router stats.
         """
-        return self._step_jit(state, self._place_batch(batch))
+        batch = self._place_batch(batch)
+        if telemetry:
+            return self._step_tel_jit(state, batch)
+        return self._step_jit(state, batch)
 
     def step_memory_analysis(self, state: TrainState, batch) -> Optional[dict]:
         """Compiler-reported per-device HBM footprint of the compiled train
@@ -719,6 +741,71 @@ class Trainer:
             "peak_bytes": arg + out + tmp - alias,
         }
 
+    def step_cost_analysis(self, state: TrainState, batch) -> Optional[dict]:
+        """Compiler-predicted cost of one train step: FLOPs and HBM bytes
+        accessed per the XLA cost model, plus the memory_analysis peak.
+
+        This is the *computed ceiling* next to the observed rate: predicted
+        FLOPs/step over device peak FLOPs gives the step time the chip
+        cannot beat, and achieved/predicted FLOP throughput is an MFU that
+        charges the model for padding and recompute the 6N estimate misses.
+        Returns None when the backend hides the analysis.
+        """
+        batch = self._place_batch(batch)
+        # Same jit object + shapes as the running step: hits the executable
+        # cache (or warms it — this doubles as an explicit compile point the
+        # goodput ledger can attribute to "compile").
+        compiled = self._step_jit.lower(state, batch).compile()
+        try:
+            ca = compiled.cost_analysis()
+        except Exception:
+            return None
+        if isinstance(ca, (list, tuple)):  # older jax: one dict per device
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        out = {}
+        if ca.get("flops"):
+            out["flops_per_step"] = float(ca["flops"])
+        if ca.get("bytes accessed"):
+            out["bytes_accessed"] = float(ca["bytes accessed"])
+        mem = self.step_memory_analysis(state, batch)
+        if mem is not None:
+            out["peak_bytes"] = mem["peak_bytes"]
+        return out or None
+
+    def nan_scan(self, state: TrainState, batch) -> dict:
+        """Forward-only activation scan: where does the first NaN/Inf appear?
+
+        Runs one deterministic forward (micro-batch 0) with the telemetry
+        capture active and bisects the per-layer absmax series host-side.
+        Returns ``{"first_nan": {"layer", "site"} | None, "sites": [...],
+        "stats": {flattened telemetry scalars}}`` — see
+        utils/telemetry.nan_report. Debug tool (``--nan_scan``); the
+        activation hooks don't run under pipeline schedules (stage > 1).
+        """
+        batch = self._place_batch(batch)
+
+        def scan_fn(st, micro):
+            with telemetry.capture(deep=True) as cap:
+                with self._sp_context():
+                    _, loss = self.model.apply(
+                        {"params": st.params}, micro, labels=micro
+                    )
+            stats = telemetry.assemble(cap.stats)
+            stats["loss"] = loss
+            return stats
+
+        stats = jax.jit(scan_fn)(state, batch[0])
+        stats = jax.device_get(stats)
+        report = telemetry.nan_report(stats)
+        report["stats"] = telemetry.flatten_scalars(
+            {k: v for k, v in stats.items() if isinstance(v, dict)},
+            prefix="nan_scan",
+        )
+        report["stats"]["nan_scan/loss"] = float(np.asarray(stats["loss"]))
+        return report
+
     def eval_step(self, state: TrainState, batch) -> jax.Array:
         """Forward-only mean loss on one ``[rows, seq]`` batch (deterministic,
         no dropout) — the eval loop the reference's dead ``eval_interval``
@@ -752,7 +839,8 @@ class Trainer:
             stack.enter_context(ring.sequence_parallel(self.mesh))
         return stack
 
-    def _train_step(self, state: TrainState, batch: jax.Array):
+    def _train_step(self, state: TrainState, batch: jax.Array,
+                    telemetry_on: bool = False):
         cfg = self.training_config
         accum = cfg.gradient_accumulation_steps
         assert batch.ndim == 3 and batch.shape[0] == accum
@@ -764,14 +852,23 @@ class Trainer:
             # cast-transpose). Identical numerics to casting here.
             if state.params_c is not None:
                 params = _linked_cast(params, state.params_c)
-            with self._sp_context():
-                _, loss = self.model.apply(
-                    {"params": params},
-                    micro,
-                    labels=micro,
-                    train=True,
-                    rngs={"dropout": rng},
-                )
+            # Telemetry variant only: activate the trace-time capture so the
+            # model routes per-layer activation/router stats out of the
+            # forward; they ride the value_and_grad aux. The steady-state
+            # trace (telemetry_on=False) is byte-identical to before.
+            cap_cm = (telemetry.capture() if telemetry_on
+                      else contextlib.nullcontext())
+            with cap_cm as cap:
+                with self._sp_context():
+                    _, loss = self.model.apply(
+                        {"params": params},
+                        micro,
+                        labels=micro,
+                        train=True,
+                        rngs={"dropout": rng},
+                    )
+            if telemetry_on:
+                return loss * scale, (loss, telemetry.assemble(cap.stats))
             return loss * scale, loss
 
         if (self.stage_size > 1
@@ -794,16 +891,26 @@ class Trainer:
                 # it the Pallas call inside the stage body would force
                 # batch replication, the memory cliff 1F1B exists to avoid.
                 with self._sp_context():
-                    return _raw_1f1b(p, micro, rng_, scale_)
+                    (scaled, loss_v), g = _raw_1f1b(p, micro, rng_, scale_)
+                if telemetry_on:
+                    # 1f1b bypasses normal AD — no forward capture here;
+                    # grad/param/update norms below still apply.
+                    return (scaled, (loss_v, {})), g
+                return (scaled, loss_v), g
         else:
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
+        fwd_stats = None
         if accum == 1:
             # No accumulation buffer — one backward, grads consumed in place.
             new_rng, sub = jax.random.split(state.rng)
-            (_, loss_sum), grads = grad_fn(
+            (_, aux), grads = grad_fn(
                 state.params, batch[0], sub, state.loss_scale
             )
+            if telemetry_on:
+                loss_sum, fwd_stats = aux
+            else:
+                loss_sum = aux
         else:
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
@@ -812,13 +919,18 @@ class Trainer:
             def micro_step(carry, micro):
                 grads_acc, loss_acc, rng = carry
                 rng, sub = jax.random.split(rng)
-                (_, loss), grads = grad_fn(state.params, micro, sub, state.loss_scale)
+                (_, aux), grads = grad_fn(state.params, micro, sub, state.loss_scale)
+                loss = aux[0] if telemetry_on else aux
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-                return (grads_acc, loss_acc + loss, rng), None
+                ys = aux[1] if telemetry_on else None
+                return (grads_acc, loss_acc + loss, rng), ys
 
-            (grads, loss_sum, new_rng), _ = jax.lax.scan(
+            (grads, loss_sum, new_rng), fwd_stack = jax.lax.scan(
                 micro_step, (zero_grads, jnp.zeros((), jnp.float32), state.rng), batch
             )
+            if telemetry_on:
+                # [accum, ...]-stacked forward stats → mean (max for absmax).
+                fwd_stats = telemetry.reduce_micro(fwd_stack)
         # Mean over micro-steps and undo the loss scale; then pin the grads to
         # their ZeRO sharding (the reduce-scatter point under zero2/zero3).
         denom = accum * state.loss_scale
@@ -876,6 +988,25 @@ class Trainer:
             "grad_norm": grad_norm,
             "loss_scale": state.loss_scale,
         }
+        if telemetry_on:
+            telem = dict(fwd_stats or {})
+            # Per-group norms from the trees the step already has in hand:
+            # the stacked [num_layers, ...] leaves reduce to a per-layer
+            # vector, embed/norm to scalars (telemetry.group_norms; the
+            # recombination to optax.global_norm is pinned by tests).
+            grad_norms = telemetry.group_norms(grads)
+            param_norms = telemetry.group_norms(state.params)
+            update_norms = telemetry.group_norms(jax.tree_util.tree_map(
+                lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+                new_params, state.params,
+            ))
+            telem["grad_norm"] = grad_norms
+            telem["param_norm"] = param_norms
+            telem["update_ratio"] = {
+                k: update_norms[k] / (param_norms[k] + 1e-20)
+                for k in update_norms
+            }
+            metrics["telemetry"] = telem
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,

@@ -8,6 +8,9 @@ programmatically (tests) or from the ``--inject_fault`` debug flag:
 
 - ``nan_loss@N``      — report a NaN loss for step N (exercises the
   divergence-rollback loop without needing real numeric blowup).
+- ``loss_spike@N``    — report a large-but-finite loss for step N
+  (exercises the telemetry spike detector's early-warning path: rollback
+  must engage *before* any NaN is ever logged).
 - ``kill@N``          — hard-kill the process (``os._exit``) at the top of
   step N, before the step runs (a preemption that outran SIGTERM).
 - ``kill_in_save@N``  — hard-kill *mid-checkpoint-save* at step N: after
@@ -37,7 +40,8 @@ import sys
 from typing import List, Optional, Tuple
 
 KINDS = frozenset(
-    {"nan_loss", "kill", "kill_in_save", "truncate_meta", "corrupt_shard"}
+    {"nan_loss", "loss_spike", "kill", "kill_in_save", "truncate_meta",
+     "corrupt_shard"}
 )
 
 # Exit code for injected kills: mimics SIGKILL's 128+9, the way a preempted

@@ -30,6 +30,17 @@ class DivergenceError(RuntimeError):
     pass
 
 
+class LossSpikeError(FloatingPointError):
+    """Loss-spike early warning (utils/telemetry.SpikeDetector tripped).
+
+    Subclasses FloatingPointError deliberately: the training CLI's
+    divergence-rollback handler catches ``(FloatingPointError,
+    DivergenceError)``, so a spike routes into the same
+    restore-and-back-off path as a NaN loss — just earlier, while the
+    checkpointed state is still healthy.
+    """
+
+
 def check_finite(step: int, loss: float) -> None:
     """Raise if the loss is NaN/Inf (bf16/fp32 paths have no loss scaler to
     absorb it; with fp16 the scaler skips the step before this sees it)."""

@@ -25,6 +25,7 @@ from typing import IO, Optional
 import jax
 
 from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.utils import telemetry as telemetry_lib
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring (public figures).
 _PEAK_FLOPS = {
@@ -40,8 +41,13 @@ _DEFAULT_PEAK = 275e12   # assume v4 when the kind string is unrecognized
 
 
 def device_peak_flops(device: Optional[jax.Device] = None) -> float:
-    """Peak bf16 FLOP/s of one chip (best-effort from device_kind)."""
-    device = device or jax.devices()[0]
+    """Peak bf16 FLOP/s of one chip (best-effort from device_kind).
+
+    Defaults to ``jax.local_devices()[0]`` — same accessor as
+    ``memory_stats`` — so multi-host processes describe a chip they
+    actually own (``jax.devices()[0]`` is host 0's first chip everywhere).
+    """
+    device = device or jax.local_devices()[0]
     kind = getattr(device, "device_kind", "").lower().replace(" ", "")
     for key, flops in _PEAK_FLOPS.items():
         if key in kind:
@@ -49,15 +55,22 @@ def device_peak_flops(device: Optional[jax.Device] = None) -> float:
     return _DEFAULT_PEAK
 
 
-def flops_per_token(config: GPTConfig) -> float:
+def flops_per_token(config: GPTConfig, seq_len: Optional[int] = None) -> float:
     """Training FLOPs per token: 6*N for parameter matmuls (fwd + bwd) plus
     12*L*S*H for the attention score/value matmuls (PaLM-appendix convention,
     full S^2 — not halved for causality). N is the ACTIVE parameter count:
     for MoE only the top-k routed experts' FFNs do work per token, so MFU
     against total params would overstate utilization by ~E/top_k on the
-    FFN share (VERDICT r3 item 8)."""
+    FFN share (VERDICT r3 item 8).
+
+    ``seq_len`` is the sequence length the run actually trains at; it
+    defaults to ``config.max_seq_len`` but the attention term scales with
+    the REAL S — a run at S=512 under a 1024-context model does half the
+    attention FLOPs, and charging it for the model max overstates MFU.
+    """
     n = config.num_active_parameters()
-    attn = 12 * config.num_layers * config.max_seq_len * config.hidden_size
+    s = seq_len if seq_len else config.max_seq_len
+    attn = 12 * config.num_layers * s * config.hidden_size
     return 6.0 * n + attn
 
 
@@ -66,11 +79,12 @@ def mfu(
     config: GPTConfig,
     n_chips: Optional[int] = None,
     peak_flops: Optional[float] = None,
+    seq_len: Optional[int] = None,
 ) -> float:
     """Model FLOPs utilization: achieved model FLOP/s over peak hardware FLOP/s."""
     n_chips = n_chips if n_chips is not None else jax.device_count()
     peak = peak_flops if peak_flops is not None else device_peak_flops()
-    return tokens_per_sec * flops_per_token(config) / (n_chips * peak)
+    return tokens_per_sec * flops_per_token(config, seq_len) / (n_chips * peak)
 
 
 def memory_stats(device: Optional[jax.Device] = None) -> dict:
@@ -111,9 +125,13 @@ class MetricLogger:
         wandb_project: Optional[str] = None,
         tensorboard_dir: Optional[str] = None,
         run_config: Optional[dict] = None,
+        seq_len: Optional[int] = None,
     ):
         self.model_config = model_config
         self.tokens_per_step = tokens_per_step
+        # Sequence length the run trains at, for the MFU attention term;
+        # None = the model's max_seq_len (flops_per_token docstring).
+        self.seq_len = seq_len
         self.log_interval = max(1, log_interval)
         self.is_main = (
             is_main_process if is_main_process is not None else jax.process_index() == 0
@@ -160,16 +178,23 @@ class MetricLogger:
         self._on_accelerator = jax.devices()[0].platform != "cpu"
 
     def log(self, step: int, metrics: dict, extra: Optional[dict] = None) -> Optional[dict]:
-        """Record one step; emit (and return) a record every ``log_interval``."""
+        """Record one step; emit (and return) a record every ``log_interval``.
+
+        A ``metrics["telemetry"]`` subtree (the trainer's telemetry-step
+        output) forces emission regardless of the interval — telemetry
+        steps are rare and already paid for the stats — and is flattened
+        into ``telemetry/*`` scalars across every sink.
+        """
         self.tokens_seen += self.tokens_per_step
         self._window_tokens += self.tokens_per_step
-        if (step + 1) % self.log_interval != 0:
+        if (step + 1) % self.log_interval != 0 and "telemetry" not in metrics:
             return None
 
         now = time.perf_counter()
         window_s = max(now - self._window_t, 1e-9)
         tok_per_sec = self._window_tokens / window_s   # windowed, not cumulative (b6)
         record = {
+            "kind": "train",
             "step": int(step),
             "loss": float(metrics.get("loss", float("nan"))),
             "lr": float(metrics.get("lr", 0.0)),
@@ -181,11 +206,14 @@ class MetricLogger:
         }
         if self.model_config is not None and self._on_accelerator:
             record["mfu"] = round(
-                mfu(tok_per_sec, self.model_config, self._n_chips, self._peak), 4
+                mfu(tok_per_sec, self.model_config, self._n_chips, self._peak,
+                    self.seq_len), 4
             )
         mem = memory_stats()
         if mem["peak_bytes_in_use"]:
             record["peak_mem_gb"] = round(mem["peak_bytes_in_use"] / 2**30, 3)
+        if "telemetry" in metrics:
+            record.update(telemetry_lib.flatten_scalars(metrics["telemetry"]))
         if extra:
             record.update(extra)
 
@@ -217,9 +245,11 @@ class MetricLogger:
             for k, v in scalars.items():
                 self._tb.add_scalar(f"{prefix}/{k}", v, step)
 
-    def log_eval(self, step: int, eval_loss: float, n_batches: int) -> dict:
+    def log_eval(self, step: int, eval_loss: float, n_batches: int,
+                 extra: Optional[dict] = None) -> dict:
         """Held-out eval record: loss + perplexity (exp clamped against
-        overflow on early-training losses), written to the same sinks."""
+        overflow on early-training losses), written to the same sinks.
+        ``extra`` merges into the record, same contract as ``log``."""
         import math
 
         record = {
@@ -229,6 +259,8 @@ class MetricLogger:
             "perplexity": round(math.exp(min(float(eval_loss), 30.0)), 4),
             "eval_batches": int(n_batches),
         }
+        if extra:
+            record.update(extra)
         if self.stdout:
             print(
                 f"eval | step {record['step']:>6d} | "
@@ -241,6 +273,25 @@ class MetricLogger:
         self._emit_scalars(record["step"], {
             "loss": record["eval_loss"], "perplexity": record["perplexity"],
         }, prefix="eval")
+        return record
+
+    def log_record(self, record: dict, stdout_lines=None) -> dict:
+        """Write an arbitrary pre-built record (``kind`` already set) to the
+        sinks: goodput ledger records, cost-analysis summaries, nan-scan
+        reports. ``stdout_lines``: optional human-readable lines for the
+        console (the raw dict goes to JSONL/wandb/TB either way)."""
+        if self.stdout and stdout_lines:
+            for line in stdout_lines:
+                print(line, flush=True)
+        if self._jsonl:
+            self._jsonl.write(json.dumps(record) + "\n")
+        step = record.get("step")
+        if step is not None:
+            self._emit_scalars(int(step), {
+                k: v for k, v in record.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+                and k != "step"
+            }, prefix=str(record.get("kind", "misc")))
         return record
 
     def close(self) -> None:
