@@ -88,6 +88,11 @@ def _build_parser():
                         "--model-flag fused_loss_pallas=0 for configs at "
                         "the HBM edge (the saved-logits buffer is the "
                         "marginal ~0.8 GB there)")
+    p.add_argument("--jsonl", default=env("BENCH_JSONL"),
+                   help="write the run's records (train windows, goodput, "
+                        "comms_model) as schema-stamped JSONL here and run "
+                        "tpu_trainer.tools.analyze over it (report on "
+                        "stderr); default: a temp file")
     p.add_argument("--table", action="store_true",
                    help="run the method x chips scaling table")
     p.add_argument("--update-results", action="store_true",
@@ -219,7 +224,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     # window reflects the machine's actual capability, the same rationale
     # as timeit's min. Each window syncs once at its end (under the axon
     # tunnel block_until_ready does not block; a host read does).
-    elapsed = float("inf")
+    window_elapsed = []
     final_loss = None
     for _ in range(5):
         t0 = time.perf_counter()
@@ -230,7 +235,8 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
                 state, metrics = trainer.train_step(state, batch)
         with ledger.track("step"):  # the device wait lands here
             final_loss = float(metrics["loss"])  # end-of-window sync
-        elapsed = min(elapsed, time.perf_counter() - t0)
+        window_elapsed.append(time.perf_counter() - t0)
+    elapsed = min(window_elapsed)
 
     n_chips = mesh.size
     tokens = steps * trainer.tokens_per_step
@@ -259,6 +265,19 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         ca = trainer.step_cost_analysis(state, batch)
     except Exception:
         ca = None
+    # Static collective-traffic model + HLO cross-check of the measured
+    # config (ISSUE 3) — failure-guarded so an exotic mesh never kills the
+    # measurement it annotates.
+    try:
+        from tpu_trainer.parallel import comms_model as comms_lib
+
+        comms = comms_lib.build(trainer)
+        hlo = trainer.compiled_step_text(state, batch)
+        if hlo:
+            comms.update(comms_lib.crosscheck(comms, hlo))
+    except Exception as e:  # pragma: no cover - defensive
+        comms = None
+        print(f"bench: comms_model failed: {e}", file=sys.stderr)
     analytic_flops_step = flops_per_token(model_config, seq_len) \
         * trainer.tokens_per_step
     goodput = ledger.record(final=True)
@@ -281,6 +300,8 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         "opt_state_dtype": opt_state_dtype,
         "offload_dtype": offload_dtype if trainer.cpu_offload else None,
         "elapsed_s": round(elapsed, 3),
+        "window_elapsed_s": [round(w, 3) for w in window_elapsed],
+        "tokens_per_window": tokens,
         "tok_per_sec": round(tok_per_sec, 1),
         "tok_per_sec_per_chip": round(tok_per_sec / n_chips, 1),
         # MFU against the attention term at the RUN's seq_len, not the
@@ -297,7 +318,59 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
             tok_per_sec * flops_per_token(model_config, seq_len), 1),
         "goodput": {k: round(v, 4) if isinstance(v, float) else v
                     for k, v in goodput.items() if k != "kind"},
+        "comms_model": comms,
     }
+
+
+def write_run_jsonl(path: str, detail: dict) -> None:
+    """Persist the bench run as the same schema-stamped JSONL a training
+    run emits: one synthetic ``train`` record per measured window (so the
+    analyzer's percentile/stability machinery applies), the goodput
+    ledger, and the comms_model record."""
+    from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+    records = []
+    cum = 0.0
+    steps = detail["steps"]
+    tokens = detail["tokens_per_window"]
+    for w, el in enumerate(detail.get("window_elapsed_s") or []):
+        cum += el
+        records.append({
+            "kind": "train",
+            "schema_version": SCHEMA_VERSION,
+            "step": (w + 1) * steps,
+            "loss": detail["final_loss"],
+            "tokens_per_sec": round(tokens / el, 1),
+            "elapsed_s": round(cum, 3),
+            "mfu": detail["mfu"],
+            "peak_mem_gb": detail["peak_mem_gb"],
+        })
+    goodput = dict(detail["goodput"])
+    goodput.update(kind="goodput", final=True, schema_version=SCHEMA_VERSION)
+    records.append(goodput)
+    if detail.get("comms_model"):
+        comms = dict(detail["comms_model"])
+        comms.setdefault("schema_version", SCHEMA_VERSION)
+        records.append(comms)
+    records.append({
+        "kind": "cost_analysis",
+        "schema_version": SCHEMA_VERSION,
+        "xla_flops_per_step": detail["xla_flops_per_step"],
+        "analytic_flops_per_step": detail["analytic_flops_per_step"],
+    })
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec, default=str) + "\n")
+
+
+def analyze_run_jsonl(path: str) -> None:
+    """Self-analysis: run the offline analyzer over the JSONL this bench
+    just wrote, report to stderr (stdout stays the driver's JSON line)."""
+    from tpu_trainer.tools import analyze as analyze_lib
+
+    report = analyze_lib.summarize(analyze_lib.load_records(path))
+    for line in analyze_lib.render(report):
+        print(f"bench: {line}", file=sys.stderr)
 
 
 def _chip_counts(n: int):
@@ -459,6 +532,7 @@ def main() -> None:
         opt_state_dtype=args.opt_state_dtype,
         offload_budget_gb=args.offload_budget_gb,
     )
+    comms = detail.get("comms_model") or {}
     result = {
         "metric": "train_tokens_per_sec",
         "value": detail["tok_per_sec"],
@@ -469,11 +543,28 @@ def main() -> None:
         "goodput_productive_frac": detail["goodput"].get("productive_frac"),
         "xla_flops_per_step": detail["xla_flops_per_step"],
         "analytic_flops_per_step": detail["analytic_flops_per_step"],
+        # Static comms/compute split of the measured config (ISSUE 3).
+        "comms_bytes_per_step": comms.get(
+            "total_bytes_per_device_per_step"),
+        "comms_compute_ratio": comms.get("comms_compute_ratio"),
+        "roofline_bound": comms.get("bound"),
     }
     # Side-channel detail (stderr keeps stdout to the single JSON line the
     # driver parses).
     print(json.dumps(result))
-    print(json.dumps(detail), file=sys.stderr)
+    print(json.dumps(detail, default=str), file=sys.stderr)
+    jsonl_path = args.jsonl
+    if not jsonl_path:
+        import tempfile
+
+        fd, jsonl_path = tempfile.mkstemp(prefix="bench_", suffix=".jsonl")
+        os.close(fd)
+    try:
+        write_run_jsonl(jsonl_path, detail)
+        print(f"bench: records -> {jsonl_path}", file=sys.stderr)
+        analyze_run_jsonl(jsonl_path)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: run analysis failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

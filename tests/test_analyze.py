@@ -1,0 +1,438 @@
+"""Run-anatomy tests (ISSUE 3): collective-traffic model, offline analyzer
++ regression gate, recompile watchdog, crash flight recorder.
+
+The comms-model lanes pin per-device byte counts against the ring-collective
+formulas computed by hand in the test (the acceptance criterion: pure-DP
+grad traffic == 2*(n-1)/n * params * 4 within 1%). The analyzer lanes run on
+synthetic JSONL so the gate semantics (PASS/FAIL/SKIP, exit codes) are
+pinned without a training run; one subprocess each drives the documented
+``python -m tpu_trainer.tools.analyze`` entrypoint and the CLI's crash
+flight-recorder path end to end.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel import comms_model
+from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+from tpu_trainer.tools import analyze
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.trainer import (
+    ParallelConfig, RecompileWatchdog, Trainer,
+)
+from tpu_trainer.utils.flight_recorder import FlightRecorder, env_snapshot
+from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_model(**kw):
+    d = dict(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+             intermediate_size=32, max_seq_len=16, dropout=0.0,
+             attention_dropout=0.0, use_flash_attention=False)
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def tiny_train(**kw):
+    d = dict(batch_size=2, max_seq_len=16, gradient_accumulation_steps=1,
+             mixed_precision="bf16", seed=0)
+    d.update(kw)
+    return TrainingConfig(**d)
+
+
+def make_trainer(mesh_cfg, strategy="replicated", model_kw=None,
+                 train_kw=None, devices=None):
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    return Trainer(tiny_model(**(model_kw or {})),
+                   tiny_train(**(train_kw or {})),
+                   ParallelConfig(mesh_cfg, strategy), mesh=mesh)
+
+
+def _param_shapes(trainer):
+    return jax.eval_shape(
+        lambda rng: trainer.model.init(
+            rng, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.PRNGKey(0))
+
+
+class TestCommsModel:
+    def test_pure_dp_matches_ring_formula(self):
+        # The acceptance criterion: pure-DP per-device grad traffic is one
+        # f32 ring all-reduce of the full gradient, 2*(n-1)/n * P * 4.
+        n = 8
+        trainer = make_trainer(MeshConfig(data=n, fsdp=1), "replicated")
+        rec = comms_model.build(trainer)
+        params = trainer.model_config.num_parameters()
+        assert rec["params"] == params
+        expected = 2.0 * (n - 1) / n * params * 4
+        got = rec["per_axis"]["data"]["bytes"]
+        assert got == pytest.approx(expected, rel=0.01)
+        # No other axis carries traffic on a pure-DP mesh.
+        for axis in ("fsdp", "tensor", "sequence", "expert", "stage"):
+            assert rec["per_axis"][axis]["bytes"] == 0.0
+        assert rec["total_bytes_per_device_per_step"] == got
+        assert rec["bound"] in ("comms", "compute")
+        json.dumps(rec, default=str)  # JSONL-able
+
+    def test_zero3_bytes_hand_computed(self):
+        # fsdp=8 zero3: grad reduce-scatter on the full f32 tree + 2 param
+        # all-gathers per step in compute dtype for >=2-D leaves (the 1-D
+        # final-norm scale stays f32). Every leaf of this tiny config is
+        # divisible by 8, so all of them shard (verified by the totals
+        # matching exactly).
+        f = 8
+        trainer = make_trainer(MeshConfig(data=1, fsdp=f), "zero3")
+        shapes = _param_shapes(trainer)
+        leaves = jax.tree_util.tree_leaves(shapes)
+        p_total = sum(int(np.prod(l.shape)) for l in leaves)
+        scatter = (f - 1) / f * p_total * 4
+        gather = 2.0 * (f - 1) / f * sum(
+            int(np.prod(l.shape)) * (2 if len(l.shape) >= 2 else 4)
+            for l in leaves)
+        rec = comms_model.build(trainer)
+        ax = rec["per_axis"]["fsdp"]
+        assert ax["scatter_bytes"] == pytest.approx(scatter, rel=1e-6)
+        assert ax["gather_bytes"] == pytest.approx(gather, rel=1e-6)
+        assert ax["bytes"] == pytest.approx(scatter + gather, rel=1e-6)
+        assert rec["per_axis"]["data"]["bytes"] == 0.0  # data axis size 1
+
+    def test_tensor_axis_bytes(self):
+        # 2-way TP: 4 activation all-reduces per layer per micro-step, each
+        # a ring all-reduce (2*(tp-1)/tp) of the [rows, seq, hidden] bf16
+        # activation block.
+        trainer = make_trainer(MeshConfig(data=4, tensor=2), "replicated")
+        tc, mc = trainer.training_config, trainer.model_config
+        payload = tc.batch_size * tc.max_seq_len * mc.hidden_size * 2
+        expected = (tc.gradient_accumulation_steps * mc.num_layers * 4
+                    * 2.0 * (2 - 1) / 2 * payload)
+        rec = comms_model.build(trainer)
+        assert rec["per_axis"]["tensor"]["bytes"] == pytest.approx(
+            expected, rel=1e-6)
+
+    def test_ring_helpers_degenerate_axis(self):
+        assert comms_model.ring_all_reduce_bytes(1000.0, 1) == 0.0
+        assert comms_model.ring_all_gather_bytes(1000.0, 1) == 0.0
+        assert comms_model.ring_sendrecv_bytes(1000.0, 1) == 0.0
+        assert comms_model.all_to_all_bytes(1000.0, 1) == 0.0
+        assert comms_model.ring_all_reduce_bytes(8.0, 4) == 12.0
+        assert comms_model.ring_sendrecv_bytes(8.0, 4) == 24.0
+
+    def test_hlo_counts_opcode_positions_only(self):
+        hlo = """
+        %ar = f32[8]{0} all-reduce(f32[8]{0} %p), replica_groups={}
+        %ag.1 = f32[8]{0} all-gather-start(f32[1]{0} %x)
+        ROOT %r = f32[8]{0} add(f32[8]{0} %ar, f32[8]{0} %all-reduce.7)
+        """
+        counts = comms_model.hlo_collective_counts(hlo)
+        assert counts["all-reduce"] == 1      # operand ref not counted
+        assert counts["all-gather"] == 1      # async -start form counted
+        assert counts["reduce-scatter"] == 0
+
+    def test_crosscheck_against_compiled_hlo_dp(self):
+        # GSPMD must insert a grad all-reduce on an 8-way DP mesh; the
+        # model charges the data axis, so the cross-check has no mismatch.
+        trainer = make_trainer(MeshConfig(data=8, fsdp=1), "replicated")
+        state = trainer.init_state()
+        rng = np.random.default_rng(0)
+        batch = trainer.put_batch(rng.integers(
+            0, 64, (trainer.global_batch_size, 16), dtype=np.int32))
+        hlo = trainer.compiled_step_text(state, batch)
+        assert hlo is not None
+        counts = comms_model.hlo_collective_counts(hlo)
+        assert counts["all-reduce"] > 0
+        rec = comms_model.build(trainer)
+        cc = comms_model.crosscheck(rec, hlo)
+        assert cc["hlo_mismatches"] == []
+
+    def test_summary_lines(self):
+        trainer = make_trainer(MeshConfig(data=8, fsdp=1), "replicated")
+        rec = comms_model.build(trainer)
+        lines = comms_model.summary_lines(rec)
+        assert any("data[8]" in l for l in lines)
+        assert any("-bound" in l for l in lines)
+
+
+# --- analyzer --------------------------------------------------------------
+
+def _run_records(tok=1000.0, n=6, mfu=0.4, mem=10.0, loss=3.0,
+                 version=SCHEMA_VERSION):
+    recs = []
+    for i in range(n):
+        recs.append({
+            "kind": "train", "schema_version": version, "step": i * 10,
+            "loss": loss - 0.01 * i, "tokens_per_sec": tok,
+            "elapsed_s": 5.0 + 2.0 * i, "mfu": mfu, "peak_mem_gb": mem,
+        })
+    recs.append({
+        "kind": "goodput", "schema_version": version, "final": True,
+        "total_seconds": 100.0, "productive_frac": 0.9, "step_frac": 0.9,
+        "data_wait_frac": 0.05, "untracked_frac": 0.05,
+    })
+    return recs
+
+
+def _write(path, records):
+    with open(path, "w") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+class TestAnalyzer:
+    def test_summarize_and_render(self, tmp_path):
+        recs = _run_records()
+        recs.append({"kind": "recompile", "schema_version": SCHEMA_VERSION,
+                     "step": 30, "executables": 2, "new_executables": 1,
+                     "batch_abstract": "int32[2,16]", "storm": False})
+        recs.append({"kind": "rollback", "schema_version": SCHEMA_VERSION,
+                     "step": 40, "cause": "FloatingPointError",
+                     "restored_step": 35})
+        path = _write(tmp_path / "run.jsonl", recs)
+        report = analyze.summarize(analyze.load_records(path))
+        assert report["train"]["tok_per_sec"]["p50"] == 1000.0
+        assert report["train"]["peak_mem_gb"] == 10.0
+        # elapsed_s advances 2 s per 10 steps -> 0.2 s/step.
+        assert report["train"]["step_time_s"]["p50"] == pytest.approx(0.2)
+        assert report["goodput"]["productive_frac"] == 0.9
+        assert report["recompiles"]["count"] == 1
+        assert report["rollbacks"][0]["cause"] == "FloatingPointError"
+        text = "\n".join(analyze.render(report))
+        assert "tok/s" in text and "recompiles 1" in text
+        assert "rollback at step 40" in text
+
+    def test_storm_flag_renders_loudly(self, tmp_path):
+        recs = _run_records()
+        recs.append({"kind": "recompile", "schema_version": SCHEMA_VERSION,
+                     "step": 30, "batch_abstract": "int32[2,8]",
+                     "storm": True})
+        report = analyze.summarize(analyze.load_records(
+            _write(tmp_path / "run.jsonl", recs)))
+        assert report["recompiles"]["storm"] is True
+        assert any("RECOMPILE STORM" in l for l in analyze.render(report))
+
+    def test_unversioned_record_exits_2(self, tmp_path, capsys):
+        recs = _run_records()
+        del recs[2]["schema_version"]
+        path = _write(tmp_path / "run.jsonl", recs)
+        assert analyze.main([path]) == 2
+        assert "schema_version" in capsys.readouterr().err
+
+    def test_wrong_version_exits_2(self, tmp_path):
+        path = _write(tmp_path / "run.jsonl", _run_records(version=999))
+        assert analyze.main([path]) == 2
+
+    def test_bad_json_and_empty_file_exit_2(self, tmp_path):
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("{not json\n")
+        assert analyze.main([str(bad)]) == 2
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert analyze.main([str(empty)]) == 2
+
+    def test_identical_runs_pass(self, tmp_path):
+        base = _write(tmp_path / "base.jsonl", _run_records())
+        new = _write(tmp_path / "new.jsonl", _run_records())
+        assert analyze.main([new, "--compare", base]) == 0
+
+    def test_tok_regression_fails(self, tmp_path):
+        base = _write(tmp_path / "base.jsonl", _run_records(tok=1000.0))
+        new = _write(tmp_path / "new.jsonl", _run_records(tok=850.0))
+        assert analyze.main([new, "--compare", base]) == 1
+
+    def test_exactly_ten_percent_fails(self, tmp_path):
+        # The documented gate is ">= 10% regression fails".
+        base = _write(tmp_path / "base.jsonl", _run_records(tok=1000.0))
+        new = _write(tmp_path / "new.jsonl", _run_records(tok=900.0))
+        assert analyze.main([new, "--compare", base]) == 1
+
+    def test_memory_regression_fails(self, tmp_path):
+        base = _write(tmp_path / "base.jsonl", _run_records(mem=10.0))
+        new = _write(tmp_path / "new.jsonl", _run_records(mem=12.0))
+        assert analyze.main([new, "--compare", base]) == 1
+
+    def test_absent_metric_skips_not_fails(self, tmp_path):
+        # CPU runs have no MFU — the gate SKIPs it rather than failing.
+        base = _write(tmp_path / "base.jsonl", _run_records(mfu=0.4))
+        new = _write(tmp_path / "new.jsonl", _run_records(mfu=None))
+        assert analyze.main([new, "--compare", base]) == 0
+
+    def test_compare_verdict_shape(self, tmp_path):
+        base = analyze.summarize(analyze.load_records(
+            _write(tmp_path / "b.jsonl", _run_records(tok=1000.0))))
+        new = analyze.summarize(analyze.load_records(
+            _write(tmp_path / "n.jsonl", _run_records(tok=1080.0))))
+        verdicts = {v["metric"]: v for v in analyze.compare(base, new)}
+        assert verdicts["tok_per_sec_p50"]["verdict"] == "PASS"  # improved
+        assert verdicts["tok_per_sec_p50"]["delta_pct"] == pytest.approx(8.0)
+        assert verdicts["final_loss"]["verdict"] == "PASS"
+        lines = analyze.render_verdicts(list(verdicts.values()))
+        assert any(l.startswith("PASS tok_per_sec_p50") for l in lines)
+
+    def test_module_entrypoint_subprocess(self, tmp_path):
+        # The documented invocation, end to end: identical runs exit 0,
+        # an injected 15% tok/s regression exits nonzero.
+        base = _write(tmp_path / "base.jsonl", _run_records(tok=1000.0))
+        same = _write(tmp_path / "same.jsonl", _run_records(tok=1000.0))
+        slow = _write(tmp_path / "slow.jsonl", _run_records(tok=850.0))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        env.pop("XLA_FLAGS", None)
+        cmd = [sys.executable, "-m", "tpu_trainer.tools.analyze"]
+        r_ok = subprocess.run(cmd + [same, "--compare", base],
+                              capture_output=True, text=True, env=env,
+                              timeout=120)
+        assert r_ok.returncode == 0, r_ok.stderr
+        assert "PASS tok_per_sec_p50" in r_ok.stdout
+        r_bad = subprocess.run(cmd + [slow, "--compare", base],
+                               capture_output=True, text=True, env=env,
+                               timeout=120)
+        assert r_bad.returncode != 0
+        assert "FAIL tok_per_sec_p50" in r_bad.stdout
+
+
+# --- recompile watchdog ----------------------------------------------------
+
+class TestRecompileWatchdog:
+    def test_fires_on_forced_shape_change(self):
+        trainer = make_trainer(
+            MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1],
+            model_kw={"max_seq_len": 32}, train_kw={"max_seq_len": 32})
+        if trainer.executable_cache_size() is None:
+            pytest.skip("jit cache-size hook unavailable on this jax")
+        state = trainer.init_state()
+        rng = np.random.default_rng(0)
+
+        def batch(seq):
+            return trainer.put_batch(
+                rng.integers(0, 64, (trainer.global_batch_size, seq),
+                             dtype=np.int32))
+
+        wd = RecompileWatchdog(trainer, warn_after=2)
+        b1 = batch(32)
+        state, _ = trainer.train_step(state, b1)
+        assert wd.observe(0, b1, expected=True) is None  # warmup compile
+        state, _ = trainer.train_step(state, b1)
+        assert wd.observe(1, b1) is None                 # cache hit
+        b2 = batch(16)
+        state, _ = trainer.train_step(state, b2)         # silent recompile
+        rec = wd.observe(2, b2)
+        assert rec is not None and rec["kind"] == "recompile"
+        assert rec["new_executables"] == 1
+        assert "16" in rec["batch_abstract"]
+        assert rec["storm"] is False
+        b3 = batch(8)
+        state, _ = trainer.train_step(state, b3)
+        rec2 = wd.observe(3, b3)
+        assert rec2 is not None and rec2["storm"] is True
+        assert rec2["recompiles_total"] == 2
+
+    def test_disarmed_watchdog_is_silent(self):
+        class Stub:
+            def executable_cache_size(self):
+                return None
+
+        wd = RecompileWatchdog(Stub())
+        assert wd.observe(0) is None
+        assert wd.events == []
+
+
+# --- crash flight recorder -------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_keeps_last_n_and_dumps(self, tmp_path):
+        fr = FlightRecorder(capacity=3, snapshot={"mesh": {"data": 1}})
+        for i in range(10):
+            fr.observe({"kind": "train", "step": i})
+        assert len(fr) == 3
+        path = fr.dump(str(tmp_path), reason="test",
+                       exc=ValueError("boom"), step=9)
+        assert os.path.basename(path) == "crash_report.json"
+        report = json.load(open(path))
+        assert report["kind"] == "crash_report"
+        assert report["schema_version"] == SCHEMA_VERSION
+        assert report["reason"] == "test" and report["step"] == 9
+        assert [r["step"] for r in report["records"]] == [7, 8, 9]
+        assert report["exception"]["type"] == "ValueError"
+        assert "boom" in report["exception"]["message"]
+        assert report["snapshot"]["mesh"] == {"data": 1}
+
+    def test_dump_overwrites_previous(self, tmp_path):
+        fr = FlightRecorder(capacity=2)
+        fr.observe({"step": 1})
+        fr.dump(str(tmp_path), reason="first")
+        fr.observe({"step": 2})
+        path = fr.dump(str(tmp_path), reason="second")
+        report = json.load(open(path))
+        assert report["reason"] == "second"
+        assert len(report["records"]) == 2
+        assert not os.path.exists(path + ".tmp")  # atomic write cleaned up
+
+    def test_env_snapshot_contents(self):
+        snap = env_snapshot(model_config=tiny_model(),
+                            training_config=tiny_train(), argv=["--x", "1"])
+        assert snap["argv"] == ["--x", "1"]
+        assert snap["model_config"]["hidden_size"] == 16
+        assert snap["training_config"]["batch_size"] == 2
+        assert "jax_version" in snap
+        assert all(any(k.startswith(p) for p in
+                       ("JAX", "XLA", "TPU", "LIBTPU", "TF_CPP"))
+                   for k in snap["env"])
+
+    def test_cli_dumps_crash_report_on_divergence(self, tmp_path):
+        # End to end: an injected NaN with no rollback budget kills the run
+        # through the divergence path, which must leave crash_report.json.
+        yaml = tmp_path / "tiny.yaml"
+        yaml.write_text("""
+model:
+  name: "gpt2-small"
+  vocab_size: 128
+  hidden_size: 32
+  num_layers: 2
+  num_heads: 2
+  intermediate_size: 64
+  max_seq_len: 32
+  dropout: 0.0
+  attention_dropout: 0.0
+  use_flash_attention: false
+training:
+  batch_size: 2
+  learning_rate: 1e-3
+  max_steps: 8
+  warmup_steps: 1
+  log_interval: 1
+  eval_interval: 0
+  save_interval: 0
+data:
+  dataset: "dummy"
+""")
+        ck = tmp_path / "ck"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        env.pop("XLA_FLAGS", None)   # 1 CPU device: speed, not mesh shape
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_trainer.training.train_ddp",
+             "--config", str(yaml),
+             "--checkpoint_dir", str(ck),
+             "--metrics_jsonl", str(tmp_path / "m.jsonl"),
+             "--inject_fault", "nan_loss@3",
+             "--guard_interval", "1",
+             "--max_rollbacks", "0",
+             "--flight_recorder_steps", "32"],
+            capture_output=True, text=True, env=env, timeout=240)
+        assert r.returncode != 0
+        report_path = ck / "crash_report.json"
+        assert report_path.exists(), r.stdout + r.stderr
+        report = json.load(open(report_path))
+        assert report["reason"].startswith("divergence")
+        assert report["exception"] is not None
+        assert report["records"], "ring should hold the emitted records"
+        assert all("schema_version" in rec for rec in report["records"])
+        assert report["snapshot"]["model_config"]["hidden_size"] == 32

@@ -58,6 +58,59 @@ class TestProfiling:
             jax.block_until_ready(jnp.ones((4, 4)) @ jnp.ones((4, 4)))
         assert (tmp_path / "host_0").is_dir()
 
+    def _fake_profiler(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda d: calls.append(("start", d)))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: calls.append(("stop",)))
+
+        class FakeAnnotation:
+            def __init__(self, name, step_num=None):
+                self.step_num = step_num
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                return False
+
+        monkeypatch.setattr(jax.profiler, "StepTraceAnnotation",
+                            FakeAnnotation)
+        return calls, FakeAnnotation
+
+    def test_windowed_trace_opens_on_resume_past_start(
+            self, tmp_path, monkeypatch):
+        # A resume landing beyond `start` must still open the window
+        # (`i == start` never fires there — the original bug), trace
+        # exactly num_steps steps, and hand back a StepTraceAnnotation
+        # for each traced step.
+        calls, FakeAnnotation = self._fake_profiler(monkeypatch)
+        wt = WindowedTrace(str(tmp_path), start=5, num_steps=3)
+        cms = [wt.step(i) for i in range(10, 16)]   # resume at step 10
+        assert [c[0] for c in calls] == ["start", "stop"]
+        assert [isinstance(c, FakeAnnotation) for c in cms] == [
+            True, True, True, False, False, False]
+        assert [c.step_num for c in cms[:3]] == [10, 11, 12]
+
+    def test_windowed_trace_single_window_per_run(
+            self, tmp_path, monkeypatch):
+        calls, _ = self._fake_profiler(monkeypatch)
+        wt = WindowedTrace(str(tmp_path), start=0, num_steps=2)
+        for i in range(10):
+            wt.step(i)
+        wt.close()
+        # One open at step 0, one close at step 2 — never re-opens.
+        assert calls == [("start", str(tmp_path / "host_0")), ("stop",)]
+
+    def test_windowed_trace_close_stops_open_window(
+            self, tmp_path, monkeypatch):
+        calls, _ = self._fake_profiler(monkeypatch)
+        wt = WindowedTrace(str(tmp_path), start=0, num_steps=100)
+        wt.step(0)
+        wt.close()
+        assert [c[0] for c in calls] == ["start", "stop"]
+
 
 class TestMetricLogger:
     def test_windowed_rate_and_jsonl(self, tmp_path):
@@ -150,6 +203,39 @@ class TestMetricLogger:
 
         files = os.listdir(tb_dir)
         assert any("tfevents" in f for f in files), files
+
+    def test_schema_version_stamped_on_every_record(self, tmp_path):
+        from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+        path = str(tmp_path / "m.jsonl")
+        logger = MetricLogger(
+            GPTConfig.gpt2_small(), tokens_per_step=100,
+            log_interval=1, jsonl_path=path, stdout=False,
+        )
+        logger.log(0, {"loss": 1.0, "lr": 1e-4, "grad_norm": 0.5})
+        logger.log_eval(0, 2.0, 1)
+        logger.log_record({"kind": "custom", "step": 0})
+        logger.close()
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 3
+        assert all(l["schema_version"] == SCHEMA_VERSION for l in lines)
+
+    def test_recorder_sees_every_record(self):
+        seen = []
+
+        class Recorder:
+            def observe(self, record):
+                seen.append(record)
+
+        logger = MetricLogger(
+            GPTConfig.gpt2_small(), tokens_per_step=100,
+            log_interval=1, stdout=False, recorder=Recorder(),
+        )
+        logger.log(0, {"loss": 1.0, "lr": 1e-4, "grad_norm": 0.5})
+        logger.log_eval(0, 2.0, 1)
+        logger.log_record({"kind": "custom", "step": 0})
+        logger.close()
+        assert [r["kind"] for r in seen] == ["train", "eval", "custom"]
 
     def test_mfu_math(self):
         cfg = GPTConfig.gpt2_small()

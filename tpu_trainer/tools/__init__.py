@@ -1,0 +1,1 @@
+"""Offline tooling over training artifacts (JSONL runs, crash reports)."""

@@ -1,0 +1,372 @@
+"""Offline run analyzer + regression gate over metrics JSONL.
+
+    python -m tpu_trainer.tools.analyze run.jsonl
+    python -m tpu_trainer.tools.analyze run.jsonl --compare base.jsonl
+
+Turns the stream a training run (or bench.py) emits —
+train/eval/goodput/telemetry/cost_analysis/comms_model/recompile/rollback
+records — into a human report: step-time percentiles, tok/s stability,
+the goodput table, spike/rollback/recompile events, and the comms share
+of the step. With ``--compare`` it renders PASS/FAIL verdicts for the new
+run against a baseline run on throughput, MFU, peak HBM, and final loss,
+and exits nonzero on any FAIL — a CI-usable gate over the bench
+trajectory (exit 0 clean, 1 regression, 2 unreadable/mis-schema'd input).
+
+Every record must carry the ``schema_version`` stamp MetricLogger writes;
+unversioned or mismatched records abort with exit 2 so old runs fail
+loudly instead of misparsing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Dict, List, Optional
+
+from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+
+class SchemaError(ValueError):
+    """A JSONL line the analyzer refuses to interpret."""
+
+
+def load_records(path: str) -> List[dict]:
+    """Parse one record per line, enforcing the schema_version stamp."""
+    records = []
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SchemaError(f"{path}:{ln}: not valid JSON ({e})")
+            if not isinstance(rec, dict):
+                raise SchemaError(f"{path}:{ln}: record is not an object")
+            version = rec.get("schema_version")
+            if version is None:
+                raise SchemaError(
+                    f"{path}:{ln}: record (kind={rec.get('kind')!r}) carries "
+                    f"no schema_version — this run predates the stamped "
+                    f"JSONL schema; re-run it under the current trainer")
+            if version != SCHEMA_VERSION:
+                raise SchemaError(
+                    f"{path}:{ln}: schema_version {version!r} != supported "
+                    f"{SCHEMA_VERSION}")
+            records.append(rec)
+    if not records:
+        raise SchemaError(f"{path}: no records")
+    return records
+
+
+def _percentile(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    if len(xs) == 1:
+        return xs[0]
+    pos = q / 100.0 * (len(xs) - 1)
+    lo = int(math.floor(pos))
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def _stats(xs: List[float]) -> Optional[dict]:
+    xs = [x for x in xs if x is not None and math.isfinite(x)]
+    if not xs:
+        return None
+    mean = sum(xs) / len(xs)
+    var = sum((x - mean) ** 2 for x in xs) / len(xs)
+    return {
+        "n": len(xs),
+        "mean": mean,
+        "p10": _percentile(xs, 10),
+        "p50": _percentile(xs, 50),
+        "p90": _percentile(xs, 90),
+        "cv": math.sqrt(var) / mean if mean else None,
+    }
+
+
+def summarize(records: List[dict]) -> dict:
+    """Reduce a record stream to the report dict ``render`` prints and
+    ``compare`` gates on."""
+    by_kind: Dict[str, List[dict]] = {}
+    for rec in records:
+        by_kind.setdefault(str(rec.get("kind")), []).append(rec)
+
+    report: dict = {"n_records": len(records)}
+
+    train = sorted(by_kind.get("train", []), key=lambda r: r.get("step", 0))
+    # Drop the first record: it absorbs compile time, and every steady-state
+    # statistic (and the compare gate) should see the post-warmup run.
+    steady = train[1:] if len(train) > 2 else train
+    if train:
+        losses = [r.get("loss") for r in steady if r.get("loss") is not None]
+        step_times = []
+        for a, b in zip(train, train[1:]):
+            ds = b.get("step", 0) - a.get("step", 0)
+            dt = (b.get("elapsed_s") or 0) - (a.get("elapsed_s") or 0)
+            if ds > 0 and dt > 0:
+                step_times.append(dt / ds)
+        report["train"] = {
+            "steps": [train[0].get("step"), train[-1].get("step")],
+            "final_loss": (_percentile(losses[-5:], 50) if losses else None),
+            "tok_per_sec": _stats(
+                [r.get("tokens_per_sec") for r in steady]),
+            "step_time_s": _stats(step_times[1:] or step_times),
+            "mfu": _stats([r.get("mfu") for r in steady
+                           if r.get("mfu") is not None]),
+            "peak_mem_gb": max(
+                (r["peak_mem_gb"] for r in train if r.get("peak_mem_gb")),
+                default=None),
+        }
+
+    evals = by_kind.get("eval", [])
+    if evals:
+        report["eval"] = {
+            "final_loss": evals[-1].get("eval_loss"),
+            "final_perplexity": evals[-1].get("perplexity"),
+            "n": len(evals),
+        }
+
+    goodput = by_kind.get("goodput", [])
+    if goodput:
+        final = [g for g in goodput if g.get("final")] or goodput
+        g = final[-1]
+        report["goodput"] = {
+            "total_seconds": g.get("total_seconds"),
+            "productive_frac": g.get("productive_frac"),
+            "fractions": {
+                k[:-len("_frac")]: v for k, v in sorted(g.items())
+                if k.endswith("_frac")
+                and k not in ("productive_frac", "untracked_frac")
+            },
+            "untracked_frac": g.get("untracked_frac"),
+        }
+
+    comms = by_kind.get("comms_model", [])
+    if comms:
+        c = comms[-1]
+        report["comms"] = {
+            "mesh": c.get("mesh"),
+            "strategy": c.get("strategy"),
+            "total_bytes_per_device_per_step":
+                c.get("total_bytes_per_device_per_step"),
+            "per_axis_bytes": {
+                axis: info.get("bytes")
+                for axis, info in (c.get("per_axis") or {}).items()
+                if info.get("bytes")},
+            "comms_seconds_est": c.get("comms_seconds_est"),
+            "compute_seconds_est": c.get("compute_seconds_est"),
+            "comms_compute_ratio": c.get("comms_compute_ratio"),
+            "bound": c.get("bound"),
+            "hlo_mismatches": c.get("hlo_mismatches"),
+        }
+
+    cost = by_kind.get("cost_analysis", [])
+    if cost:
+        report["cost"] = {k: cost[-1].get(k) for k in (
+            "xla_flops_per_step", "analytic_flops_per_step",
+            "xla_peak_bytes") if cost[-1].get(k) is not None}
+
+    recompiles = by_kind.get("recompile", [])
+    if recompiles:
+        report["recompiles"] = {
+            "count": len(recompiles),
+            "steps": [r.get("step") for r in recompiles],
+            "shapes": sorted({str(r.get("batch_abstract"))
+                              for r in recompiles}),
+            "storm": any(r.get("storm") for r in recompiles),
+        }
+
+    rollbacks = by_kind.get("rollback", [])
+    if rollbacks:
+        report["rollbacks"] = [{
+            "step": r.get("step"),
+            "cause": r.get("cause"),
+            "restored_step": r.get("restored_step"),
+        } for r in rollbacks]
+
+    telemetry_steps = [r.get("step") for r in train
+                       if any(k.startswith("telemetry/") for k in r)]
+    if telemetry_steps:
+        report["telemetry_steps"] = len(telemetry_steps)
+    return report
+
+
+def _fmt(x, nd=2, default="-"):
+    if x is None:
+        return default
+    if isinstance(x, float):
+        return f"{x:,.{nd}f}"
+    return str(x)
+
+
+def render(report: dict) -> List[str]:
+    """Human report lines."""
+    lines = [f"== run analysis ({report['n_records']} records) =="]
+    t = report.get("train")
+    if t:
+        lines.append(f"steps {t['steps'][0]}..{t['steps'][1]}"
+                     f" | final loss {_fmt(t['final_loss'], 4)}")
+        tok = t.get("tok_per_sec")
+        if tok:
+            lines.append(
+                f"tok/s   p10 {_fmt(tok['p10'], 0)}  p50 {_fmt(tok['p50'], 0)}"
+                f"  p90 {_fmt(tok['p90'], 0)}  cv {_fmt(tok['cv'], 3)}")
+        st = t.get("step_time_s")
+        if st:
+            lines.append(
+                f"step_s  p10 {_fmt(st['p10'], 4)}  p50 {_fmt(st['p50'], 4)}"
+                f"  p90 {_fmt(st['p90'], 4)}")
+        if t.get("mfu"):
+            lines.append(f"mfu     p50 {_fmt(t['mfu']['p50'], 4)}")
+        if t.get("peak_mem_gb") is not None:
+            lines.append(f"peak HBM {_fmt(t['peak_mem_gb'])} GB")
+    else:
+        lines.append("no train records")
+    e = report.get("eval")
+    if e:
+        lines.append(f"eval    loss {_fmt(e['final_loss'], 4)}"
+                     f"  ppl {_fmt(e['final_perplexity'])} ({e['n']} evals)")
+    g = report.get("goodput")
+    if g:
+        fr = "  ".join(f"{k} {_fmt(v * 100, 1)}%"
+                       for k, v in g["fractions"].items())
+        lines.append(f"goodput {_fmt((g.get('productive_frac') or 0) * 100, 1)}%"
+                     f" productive over {_fmt(g['total_seconds'], 1)}s"
+                     f" | {fr}"
+                     f" | untracked {_fmt((g.get('untracked_frac') or 0) * 100, 1)}%")
+    c = report.get("comms")
+    if c:
+        axes = "  ".join(f"{k} {_fmt(v / 1e6, 1)}MB"
+                         for k, v in c["per_axis_bytes"].items())
+        lines.append(
+            f"comms   {_fmt((c.get('total_bytes_per_device_per_step') or 0) / 1e6, 1)}"
+            f" MB/device/step ({axes or 'none'})"
+            f" | est comms/compute {_fmt(c.get('comms_compute_ratio'))}"
+            f" -> {c.get('bound')}-bound")
+        for m in c.get("hlo_mismatches") or []:
+            lines.append(f"comms   HLO mismatch: {m}")
+    r = report.get("recompiles")
+    if r:
+        flag = "  ** RECOMPILE STORM (loader shape churn?) **" if r["storm"] else ""
+        lines.append(f"recompiles {r['count']} at steps {r['steps']}"
+                     f" shapes {r['shapes']}{flag}")
+    for rb in report.get("rollbacks", []):
+        lines.append(f"rollback at step {rb['step']} ({rb['cause']})"
+                     f" -> restored step {rb['restored_step']}")
+    return lines
+
+
+# --- the regression gate ---------------------------------------------------
+
+def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
+            mfu_tol: float = 0.10, mem_tol: float = 0.10,
+            loss_tol: float = 0.05) -> List[dict]:
+    """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
+
+    Relative regressions at or beyond the tolerance FAIL (so exactly-10%
+    tok/s loss fails the default gate); metrics absent from either run
+    SKIP (CPU runs have no MFU or HBM) — SKIP never fails CI.
+    """
+    def get(report, *keys):
+        cur = report
+        for k in keys:
+            if not isinstance(cur, dict) or cur.get(k) is None:
+                return None
+            cur = cur[k]
+        return cur
+
+    specs = [
+        ("tok_per_sec_p50", ("train", "tok_per_sec", "p50"), "higher", tok_tol),
+        ("mfu_p50", ("train", "mfu", "p50"), "higher", mfu_tol),
+        ("peak_mem_gb", ("train", "peak_mem_gb"), "lower", mem_tol),
+        ("final_loss", ("train", "final_loss"), "lower", loss_tol),
+    ]
+    verdicts = []
+    eps = 1e-9
+    for name, keys, better, tol in specs:
+        b, n = get(base, *keys), get(new, *keys)
+        if b is None or n is None or b == 0:
+            verdicts.append({"metric": name, "verdict": "SKIP",
+                             "base": b, "new": n})
+            continue
+        delta = (n - b) / abs(b)
+        regression = -delta if better == "higher" else delta
+        verdicts.append({
+            "metric": name,
+            "verdict": "FAIL" if regression >= tol - eps else "PASS",
+            "base": b,
+            "new": n,
+            "delta_pct": round(delta * 100, 2),
+            "tolerance_pct": round(tol * 100, 2),
+        })
+    return verdicts
+
+
+def render_verdicts(verdicts: List[dict]) -> List[str]:
+    lines = ["== regression gate (new vs base) =="]
+    for v in verdicts:
+        if v["verdict"] == "SKIP":
+            lines.append(f"SKIP {v['metric']:<16} (absent in one run)")
+        else:
+            lines.append(
+                f"{v['verdict']} {v['metric']:<16} base {_fmt(v['base'], 4)}"
+                f" new {_fmt(v['new'], 4)} ({v['delta_pct']:+.1f}%,"
+                f" tol {v['tolerance_pct']:.0f}%)")
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_trainer.tools.analyze",
+        description="Analyze a training-run metrics JSONL; optionally gate "
+                    "it against a baseline run.")
+    parser.add_argument("run", help="metrics JSONL of the run to analyze")
+    parser.add_argument("--compare", metavar="BASE",
+                        help="baseline JSONL; exit 1 on regression")
+    parser.add_argument("--tok-tol", type=float, default=0.10,
+                        help="tok/s relative tolerance (default 0.10)")
+    parser.add_argument("--mfu-tol", type=float, default=0.10)
+    parser.add_argument("--mem-tol", type=float, default=0.10)
+    parser.add_argument("--loss-tol", type=float, default=0.05)
+    parser.add_argument("--json", action="store_true",
+                        help="print the report (and verdicts) as JSON")
+    args = parser.parse_args(argv)
+
+    try:
+        report = summarize(load_records(args.run))
+    except SchemaError as e:
+        print(f"analyze: {e}", file=sys.stderr)
+        return 2
+
+    verdicts = None
+    if args.compare:
+        try:
+            base_report = summarize(load_records(args.compare))
+        except SchemaError as e:
+            print(f"analyze: {e}", file=sys.stderr)
+            return 2
+        verdicts = compare(
+            base_report, report, tok_tol=args.tok_tol, mfu_tol=args.mfu_tol,
+            mem_tol=args.mem_tol, loss_tol=args.loss_tol)
+
+    if args.json:
+        print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))
+    else:
+        for line in render(report):
+            print(line)
+        if verdicts is not None:
+            for line in render_verdicts(verdicts):
+                print(line)
+    if verdicts is not None and any(v["verdict"] == "FAIL" for v in verdicts):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

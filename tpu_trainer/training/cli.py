@@ -36,11 +36,15 @@ from typing import Optional
 import numpy as np
 
 from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel import comms_model as comms_lib
 from tpu_trainer.parallel import mesh as mesh_lib
 from tpu_trainer.training.config import TrainingConfig
-from tpu_trainer.training.trainer import ParallelConfig, Trainer
+from tpu_trainer.training.trainer import (
+    ParallelConfig, RecompileWatchdog, Trainer,
+)
 from tpu_trainer.utils import checkpoint as ckpt_lib
 from tpu_trainer.utils import faults, guards, profiling
+from tpu_trainer.utils import flight_recorder as flight_lib
 from tpu_trainer.utils import telemetry as telemetry_lib
 from tpu_trainer.utils.logging import MetricLogger, flops_per_token
 
@@ -169,6 +173,18 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                    help="loss-spike early warning: raise (and roll back) when "
                         "the logged loss exceeds the rolling median by this "
                         "many MAD-sigmas (default 6; 0 disables)")
+    # run anatomy (ISSUE 3): comms model, recompile watchdog, flight recorder
+    p.add_argument("--no_comms_model", action="store_true", default=None,
+                   help="skip the one-time kind:\"comms_model\" record "
+                        "(analytic per-axis collective bytes/step + "
+                        "comms-vs-compute roofline, cross-checked against "
+                        "the compiled HLO)")
+    p.add_argument("--flight_recorder_steps", type=int, default=None,
+                   help="crash flight recorder: ring-buffer capacity of "
+                        "recent JSONL records dumped (with a config/mesh/env "
+                        "snapshot) as crash_report.json under "
+                        "--checkpoint_dir on SIGTERM/rollback/crash "
+                        "(default 256; 0 disables)")
     p.add_argument("--nan_scan", action="store_true", default=None,
                    help="debug: run one forward-only activation scan on the "
                         "first batch, report the first layer/site with a "
@@ -440,6 +456,10 @@ def resolve_configs(args, mode: str):
         "telemetry_interval": _picki(args.telemetry_interval, None, 0),
         "spike_sigma": _pickf(args.spike_sigma, None, 6.0),
         "nan_scan": bool(_pick(args.nan_scan, False)),
+        # Run anatomy (ISSUE 3).
+        "comms_model": not bool(_pick(args.no_comms_model, False)),
+        "flight_recorder_steps": _picki(args.flight_recorder_steps,
+                                        None, 256),
     }
     return model_config, training_config, parallel_config, data_opts
 
@@ -599,6 +619,30 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 print(f"data state not restored ({e}); reading the dataset "
                       f"from the start", flush=True)
 
+    # --- crash flight recorder (ISSUE 3): ring of emitted records ------
+    recorder = None
+    if data_opts["flight_recorder_steps"] > 0:
+        recorder = flight_lib.FlightRecorder(
+            capacity=data_opts["flight_recorder_steps"],
+            snapshot=flight_lib.env_snapshot(
+                trainer=trainer, model_config=model_config,
+                training_config=training_config, argv=argv),
+        )
+
+    def dump_flight(reason: str, exc: Optional[BaseException] = None):
+        """Best-effort crash_report.json — never masks the real failure."""
+        if recorder is None:
+            return
+        try:
+            path = recorder.dump(
+                training_config.checkpoint_dir, reason=reason, exc=exc,
+                step=int(state.step) if state is not None else None)
+            if main:
+                print(f"flight recorder: wrote {path} ({reason})", flush=True)
+        except Exception as dump_err:
+            if main:
+                print(f"flight recorder dump failed: {dump_err}", flush=True)
+
     logger = MetricLogger(
         model_config,
         tokens_per_step=trainer.tokens_per_step,
@@ -612,6 +656,7 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             "training": dataclasses.asdict(training_config),
         },
         seq_len=training_config.max_seq_len,
+        recorder=recorder,
     )
     logger.tokens_seen = tokens_seen
 
@@ -750,6 +795,10 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     jit_warm = {"step": False, "telemetry": False}
     cost_emitted = False
     replay_until = -1   # steps <= this are rollback replay, not fresh work
+    # Recompile watchdog (ISSUE 3): executable-cache growth after warmup
+    # means XLA recompiled the step — log it; repeated growth is a storm
+    # (loader shape churn) and warns loudly.
+    watchdog = RecompileWatchdog(trainer)
 
     try:
         while True:
@@ -759,34 +808,56 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 for step in range(start_step, training_config.max_steps):
                     if faults.fire("kill", step):
                         faults.kill()
-                    profiler.step(step)
-                    with ledger.track("data_wait"):
-                        batch = next_batch()
-                    tel_step = bool(telemetry_interval
-                                    and (step + 1) % telemetry_interval == 0)
-                    variant = "telemetry" if tel_step else "step"
-                    category = ("compile" if not jit_warm[variant]
-                                else "rollback_replay" if step <= replay_until
-                                else "step")
-                    # The logger's loss read is the device sync point, so it
-                    # stays inside the tracked block — otherwise async
-                    # dispatch would bank the real compute under "untracked".
-                    with ledger.track(category):
-                        state, metrics = trainer.train_step(
-                            state, batch, telemetry=tel_step)
-                        if not jit_warm[variant]:
-                            jax.block_until_ready(metrics["loss"])
-                            jit_warm[variant] = True
-                        steps_this_run += 1
-                        if faults.fire("nan_loss", step):
-                            metrics = dict(metrics)
-                            metrics["loss"] = float("nan")
-                        if faults.fire("loss_spike", step):
-                            # Large but finite: the early-warning path must
-                            # engage before anything trips the NaN guard.
-                            metrics = dict(metrics)
-                            metrics["loss"] = float(metrics["loss"]) * 8.0 + 5.0
-                        record = logger.log(step, metrics)
+                    # profiler.step returns a StepTraceAnnotation context
+                    # inside the trace window (per-step grouping in the
+                    # viewer), a nullcontext outside it.
+                    with profiler.step(step):
+                        with ledger.track("data_wait"):
+                            batch = next_batch()
+                        tel_step = bool(
+                            telemetry_interval
+                            and (step + 1) % telemetry_interval == 0)
+                        variant = "telemetry" if tel_step else "step"
+                        expected_compile = not jit_warm[variant]
+                        category = ("compile" if expected_compile
+                                    else "rollback_replay"
+                                    if step <= replay_until else "step")
+                        # The logger's loss read is the device sync point,
+                        # so it stays inside the tracked block — otherwise
+                        # async dispatch would bank the real compute under
+                        # "untracked".
+                        with ledger.track(category):
+                            state, metrics = trainer.train_step(
+                                state, batch, telemetry=tel_step)
+                            if not jit_warm[variant]:
+                                jax.block_until_ready(metrics["loss"])
+                                jit_warm[variant] = True
+                            steps_this_run += 1
+                            if faults.fire("nan_loss", step):
+                                metrics = dict(metrics)
+                                metrics["loss"] = float("nan")
+                            if faults.fire("loss_spike", step):
+                                # Large but finite: the early-warning path
+                                # must engage before anything trips the NaN
+                                # guard.
+                                metrics = dict(metrics)
+                                metrics["loss"] = (
+                                    float(metrics["loss"]) * 8.0 + 5.0)
+                            record = logger.log(step, metrics)
+                    wd_rec = watchdog.observe(step, batch,
+                                              expected=expected_compile)
+                    if wd_rec is not None:
+                        wd_lines = [
+                            f"recompile | step {step}: train step recompiled"
+                            f" for {wd_rec['batch_abstract']} (executables: "
+                            f"{wd_rec['executables']})"]
+                        if wd_rec.get("storm"):
+                            wd_lines.append(
+                                "recompile | WARNING: steady-state "
+                                f"recompilation ({wd_rec['recompiles_total']}"
+                                " events after warmup) — input shapes are "
+                                "churning; check the loader/bucketing")
+                        logger.log_record(wd_rec, stdout_lines=wd_lines)
                     if not cost_emitted:
                         # One-time XLA cost model vs analytic FLOPs. Runs
                         # after the first step so .lower().compile() hits the
@@ -814,6 +885,26 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                                     "cost_analysis | predicted peak HBM "
                                     f"{cost['peak_bytes'] / 2**30:.2f} GiB")
                             logger.log_record(rec, stdout_lines=lines)
+                        if data_opts["comms_model"]:
+                            # One-time analytic collective-traffic record,
+                            # cross-checked against the collectives GSPMD
+                            # actually put in the compiled step's HLO.
+                            try:
+                                comms = comms_lib.build(trainer)
+                                comms["step"] = step
+                                hlo = trainer.compiled_step_text(state, batch)
+                                if hlo:
+                                    comms.update(
+                                        comms_lib.crosscheck(comms, hlo))
+                                logger.log_record(
+                                    comms,
+                                    stdout_lines=comms_lib.summary_lines(
+                                        comms))
+                            except Exception as comms_err:
+                                if main:
+                                    print("comms_model failed: "
+                                          f"{type(comms_err).__name__}: "
+                                          f"{comms_err}", flush=True)
                     if spike is not None and record is not None:
                         is_spike, z = spike.update(record["loss"])
                         if is_spike:
@@ -851,6 +942,7 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                         if main:
                             print("SIGTERM received: checkpointing and exiting")
                         save("preempt")
+                        dump_flight("sigterm")
                         return 143
                 save("final")
                 if not (training_config.eval_interval > 0
@@ -910,6 +1002,20 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 if hasattr(data_iter, "close"):
                     data_iter.close()
                 data_iter = iter(train_loader)
+                # The rebuilt trainer (LR backoff) has a fresh executable
+                # cache; re-arm the watchdog on it either way so the
+                # watermark matches the trainer actually stepping.
+                watchdog = RecompileWatchdog(trainer)
+                logger.log_record({
+                    "kind": "rollback",
+                    "step": int(step),
+                    "rollback": rollbacks,
+                    "max_rollbacks": max_rollbacks,
+                    "cause": type(err).__name__,
+                    "restored_step": int(state.step),
+                    "lr_backoff": backoff,
+                })
+                dump_flight(f"rollback:{type(err).__name__}", exc=err)
                 if main:
                     print(f"rollback {rollbacks}/{max_rollbacks}: "
                           f"{type(err).__name__} at step {step}; rewound to "
@@ -918,11 +1024,13 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                           flush=True)
         logger.log_record(ledger.record(step=int(state.step), final=True),
                           stdout_lines=ledger.summary_lines())
-    except (FloatingPointError, guards.DivergenceError):
+    except (FloatingPointError, guards.DivergenceError) as err:
+        dump_flight("divergence", exc=err)
         raise  # poisoned state: never crash-save it
     except (KeyboardInterrupt, SystemExit):
         raise
-    except Exception:
+    except Exception as err:
+        dump_flight("crash", exc=err)
         # Best-effort crash checkpoint: only after real progress this run
         # (an immediate failure would just overwrite good state with noise).
         if steps_this_run >= 1:

@@ -272,6 +272,27 @@ class Trainer:
                 self.model_config = dataclasses.replace(
                     self.model_config, fused_projections=False
                 )
+        # jax-0.4.37 workaround (ROADMAP open item): composed sequence x
+        # tensor meshes NaN inside the blockwise fused head+CE even though
+        # every activation (including the full-vocab logits) is finite.
+        # Fall back to the unfused head + CE there; numerics are identical,
+        # only the logits materialization differs.
+        from tpu_trainer.utils import jax_compat
+
+        if (self.sp_size > 1 and self.tp_size > 1
+                and self.model_config.fused_loss
+                and not jax_compat.FUSED_LOSS_SEQ_TP_OK):
+            import warnings
+
+            warnings.warn(
+                "sequence x tensor mesh on old jax: disabling fused_loss "
+                "(known fused head+CE NaN on this API generation; see "
+                "ROADMAP open items)",
+                stacklevel=2,
+            )
+            self.model_config = dataclasses.replace(
+                self.model_config, fused_loss=False
+            )
         self.stage_size = self.mesh.shape.get(mesh_lib.STAGE_AXIS, 1)
         if self.stage_size > 1:
             # Pipeline parallelism (parallel/pipeline.py): contiguous layer
@@ -774,6 +795,36 @@ class Trainer:
             out["peak_bytes"] = mem["peak_bytes"]
         return out or None
 
+    def compiled_step_text(self, state: TrainState, batch) -> Optional[str]:
+        """Post-optimization HLO of the compiled train step (or None).
+
+        Used by parallel/comms_model.crosscheck to count the collective ops
+        GSPMD actually inserted against the analytic traffic model. Same jit
+        object + shapes as the running step, so this hits the executable
+        cache rather than recompiling.
+        """
+        batch = self._place_batch(batch)
+        try:
+            return self._step_jit.lower(state, batch).compile().as_text()
+        except Exception:
+            return None
+
+    def executable_cache_size(self) -> Optional[int]:
+        """Number of executables cached across the train-step jit variants.
+
+        Growth after warmup means XLA recompiled the step — on TPU a
+        multi-second stall per occurrence, usually shape churn from the
+        loader. Returns None when this jax doesn't expose the private
+        cache-size hook (the watchdog then disarms rather than guessing).
+        """
+        total = 0
+        for fn in (self._step_jit, self._step_tel_jit):
+            try:
+                total += fn._cache_size()
+            except Exception:
+                return None
+        return total
+
     def nan_scan(self, state: TrainState, batch) -> dict:
         """Forward-only activation scan: where does the first NaN/Inf appear?
 
@@ -1017,3 +1068,65 @@ class Trainer:
         if self.use_loss_scaling:
             new_state = new_state.replace(loss_scale=new_scale, good_steps=new_good)
         return new_state, metrics
+
+
+class RecompileWatchdog:
+    """Detect steady-state recompilation of the jitted train step.
+
+    ``jax.jit`` silently compiles a fresh executable for every new abstract
+    input signature; a loader that churns shapes (ragged tails, bucketing
+    bugs) turns each "cache miss" into a multi-second compile stall that
+    telemetry otherwise books as ordinary step time. The watchdog samples
+    ``Trainer.executable_cache_size()`` after every step: growth that the
+    training loop did not expect (first use of the plain or telemetry
+    variant is expected) produces a ``kind:"recompile"`` record carrying
+    the offending batch's abstract shape; ``warn_after`` unexpected events
+    flips ``storm`` on, the loop's cue to warn loudly.
+
+    Disarms (observe returns None forever) when the cache-size hook is
+    unavailable on this jax.
+    """
+
+    def __init__(self, trainer: Trainer, warn_after: int = 3):
+        self.trainer = trainer
+        self.warn_after = warn_after
+        self.events: list = []
+        self._watermark: Optional[int] = None
+        self._armed = trainer.executable_cache_size() is not None
+
+    def observe(self, step: int, batch=None,
+                expected: bool = False) -> Optional[dict]:
+        """Sample the executable cache after ``step`` ran on ``batch``.
+
+        ``expected=True`` raises the watermark silently (warmup compiles:
+        the first use of each step variant). Returns the recompile record
+        to log, or None when nothing unexpected happened.
+        """
+        if not self._armed:
+            return None
+        size = self.trainer.executable_cache_size()
+        if size is None:
+            self._armed = False
+            return None
+        if self._watermark is None or expected:
+            self._watermark = max(self._watermark or 0, size)
+            return None
+        if size <= self._watermark:
+            return None
+        grew = size - self._watermark
+        self._watermark = size
+        shape = tuple(getattr(batch, "shape", ()) or ())
+        dtype = getattr(batch, "dtype", None)
+        record = {
+            "kind": "recompile",
+            "step": int(step),
+            "executables": int(size),
+            "new_executables": int(grew),
+            "batch_abstract": "{}[{}]".format(
+                dtype if dtype is not None else "?",
+                ",".join(str(d) for d in shape)),
+        }
+        self.events.append(record)
+        record["recompiles_total"] = len(self.events)
+        record["storm"] = len(self.events) >= self.warn_after
+        return record

@@ -1,0 +1,135 @@
+"""Crash flight recorder: last-N JSONL records + run snapshot on failure.
+
+When a run dies — SIGTERM from the scheduler, a divergence that exhausts
+its rollback budget, an unhandled exception — the JSONL on disk shows the
+*emitted* history but not the run's identity (configs, mesh, env, jax
+version) in one artifact, and a preempted pod may not flush anything at
+all. The flight recorder keeps a bounded in-memory ring of every record
+the MetricLogger emits plus a one-time environment snapshot, and dumps
+both as ``crash_report.json`` (atomic write) from the existing
+SIGTERM/rollback/fault paths in ``run_training``. Postmortem = one file.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import sys
+import time
+import traceback
+from typing import Any, Optional
+
+from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+_ENV_PREFIXES = ("JAX", "XLA", "TPU", "LIBTPU", "TF_CPP")
+
+
+def env_snapshot(trainer=None, model_config=None, training_config=None,
+                 argv=None) -> dict:
+    """One-time run-identity snapshot: versions, devices, mesh, configs,
+    accelerator-relevant env vars, argv. Everything best-effort — a
+    snapshot field that fails to collect is omitted, never fatal."""
+    snap: dict = {
+        "python": sys.version.split()[0],
+        "argv": list(argv) if argv is not None else sys.argv[1:],
+        "env": {
+            k: v for k, v in sorted(os.environ.items())
+            if any(k.startswith(p) for p in _ENV_PREFIXES)
+        },
+    }
+    try:
+        import jax
+
+        snap["jax_version"] = jax.__version__
+        dev = jax.devices()[0]
+        snap["platform"] = dev.platform
+        snap["device_kind"] = getattr(dev, "device_kind", "unknown")
+        snap["device_count"] = jax.device_count()
+        snap["process_index"] = jax.process_index()
+        snap["process_count"] = jax.process_count()
+    except Exception:
+        pass
+    if trainer is not None:
+        try:
+            snap["mesh"] = dict(trainer.mesh.shape)
+            snap["strategy"] = trainer.strategy
+        except Exception:
+            pass
+    for name, cfg in (("model_config", model_config),
+                      ("training_config", training_config)):
+        if cfg is not None:
+            try:
+                snap[name] = dataclasses.asdict(cfg)
+            except Exception:
+                pass
+    return snap
+
+
+class FlightRecorder:
+    """Bounded ring of emitted JSONL records + snapshot, dumpable on crash.
+
+    Fed by ``MetricLogger(recorder=...)`` — every record that reaches the
+    JSONL also lands here, so the ring IS the tail of the metrics stream
+    (train/eval/goodput/telemetry/comms_model/recompile/rollback alike).
+    """
+
+    def __init__(self, capacity: int = 256, snapshot: Optional[dict] = None):
+        self.capacity = int(capacity)
+        self.snapshot = snapshot or {}
+        self._ring: collections.deque = collections.deque(maxlen=self.capacity)
+
+    def observe(self, record: dict) -> None:
+        self._ring.append(record)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def dump(self, directory: str, *, reason: str,
+             exc: Optional[BaseException] = None,
+             step: Optional[int] = None) -> str:
+        """Write ``crash_report.json`` under ``directory`` and return its
+        path. Atomic (tmp + rename): a crash during the dump never leaves
+        a torn report. Non-zero hosts write ``crash_report_host{k}.json``.
+        The last dump of a run wins — later events overwrite earlier ones,
+        which is the postmortem-relevant ordering."""
+        host = 0
+        try:
+            import jax
+
+            host = jax.process_index()
+        except Exception:
+            pass
+        name = ("crash_report.json" if host == 0
+                else f"crash_report_host{host}.json")
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, name)
+        report: dict = {
+            "kind": "crash_report",
+            "schema_version": SCHEMA_VERSION,
+            "reason": reason,
+            "step": step,
+            "written_unix": time.time(),
+            "exception": _format_exc(exc),
+            "snapshot": self.snapshot,
+            "records": list(self._ring),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(report, fh, indent=1, default=str)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+def _format_exc(exc: Optional[BaseException]) -> Optional[dict]:
+    if exc is None:
+        return None
+    return {
+        "type": type(exc).__name__,
+        "message": str(exc),
+        "traceback": "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__)),
+    }

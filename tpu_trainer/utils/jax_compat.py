@@ -27,6 +27,15 @@ except ImportError:  # old API: experimental, check_rep, auto
 # fallback should consult this flag and take the fallback on old jax.
 PARTIAL_MANUAL_OK = _NEW
 
+# The blockwise fused head+CE (ops/loss.py fused_shifted_cross_entropy)
+# produces NaN under sequence-sharded activations when the mesh composes
+# sequence x tensor axes on the old API generation. Localized by --nan_scan
+# (ROADMAP open item): every activation site including the full-vocab
+# logits is finite, the loss is the first non-finite value, and the same
+# mesh with ``fused_loss: false`` is finite end to end. The Trainer
+# auto-disables fused_loss on those meshes when this is False.
+FUSED_LOSS_SEQ_TP_OK = _NEW
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
               check_vma=True):

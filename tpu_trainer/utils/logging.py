@@ -27,6 +27,13 @@ import jax
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.utils import telemetry as telemetry_lib
 
+# Version stamp carried by every JSONL record this process emits. The
+# offline analyzer (tpu_trainer.tools.analyze) refuses records whose stamp
+# is missing or different, so schema drift fails loudly at analysis time
+# instead of silently misparsing old runs. Bump on any breaking change to
+# record field semantics.
+SCHEMA_VERSION = 1
+
 # Peak dense bf16 FLOP/s per chip, by device_kind substring (public figures).
 _PEAK_FLOPS = {
     "v6": 918e12,        # Trillium (v6e)
@@ -126,7 +133,12 @@ class MetricLogger:
         tensorboard_dir: Optional[str] = None,
         run_config: Optional[dict] = None,
         seq_len: Optional[int] = None,
+        recorder=None,
     ):
+        # Crash flight recorder (utils/flight_recorder.FlightRecorder):
+        # every record emitted to the sinks is also observed by the ring
+        # buffer, so a crash report carries the tail of the metrics stream.
+        self._recorder = recorder
         self.model_config = model_config
         self.tokens_per_step = tokens_per_step
         # Sequence length the run trains at, for the MFU attention term;
@@ -195,6 +207,7 @@ class MetricLogger:
         tok_per_sec = self._window_tokens / window_s   # windowed, not cumulative (b6)
         record = {
             "kind": "train",
+            "schema_version": SCHEMA_VERSION,
             "step": int(step),
             "loss": float(metrics.get("loss", float("nan"))),
             "lr": float(metrics.get("lr", 0.0)),
@@ -234,6 +247,8 @@ class MetricLogger:
             k: v for k, v in record.items()
             if isinstance(v, (int, float)) and k != "step"
         }, prefix="train")
+        if self._recorder is not None:
+            self._recorder.observe(record)
         return record
 
     def _emit_scalars(self, step: int, scalars: dict, prefix: str) -> None:
@@ -254,6 +269,7 @@ class MetricLogger:
 
         record = {
             "kind": "eval",
+            "schema_version": SCHEMA_VERSION,
             "step": int(step),
             "eval_loss": float(eval_loss),
             "perplexity": round(math.exp(min(float(eval_loss), 30.0)), 4),
@@ -273,6 +289,8 @@ class MetricLogger:
         self._emit_scalars(record["step"], {
             "loss": record["eval_loss"], "perplexity": record["perplexity"],
         }, prefix="eval")
+        if self._recorder is not None:
+            self._recorder.observe(record)
         return record
 
     def log_record(self, record: dict, stdout_lines=None) -> dict:
@@ -280,6 +298,7 @@ class MetricLogger:
         sinks: goodput ledger records, cost-analysis summaries, nan-scan
         reports. ``stdout_lines``: optional human-readable lines for the
         console (the raw dict goes to JSONL/wandb/TB either way)."""
+        record.setdefault("schema_version", SCHEMA_VERSION)
         if self.stdout and stdout_lines:
             for line in stdout_lines:
                 print(line, flush=True)
@@ -292,6 +311,8 @@ class MetricLogger:
                 if isinstance(v, (int, float)) and not isinstance(v, bool)
                 and k != "step"
             }, prefix=str(record.get("kind", "misc")))
+        if self._recorder is not None:
+            self._recorder.observe(record)
         return record
 
     def close(self) -> None:

@@ -46,28 +46,43 @@ def start_server(port: int = 9999):
 
 
 class WindowedTrace:
-    """Trace exactly the steps in ``[start, start + num_steps)``.
+    """Trace ``num_steps`` consecutive steps starting at the first step
+    ``>= start``.
 
-    Call ``step(i)`` at the top of every training step; the first traced step
-    is ``start`` (letting compile/warmup steps pass untraced), and the trace
-    stops after ``num_steps`` steps or at ``close()``.
+    Call ``step(i)`` at the top of every training step — it returns a
+    context manager to run the step's work under, so traced steps carry a
+    ``jax.profiler.StepTraceAnnotation`` and the trace viewer groups the
+    timeline per step (a no-op context outside the window)::
+
+        with profiler.step(i):
+            ... data wait + train_step ...
+
+    The first traced step is the first one at or past ``start`` (a resume
+    that lands beyond ``start`` still opens the window — ``i == start``
+    would never fire there); the trace stops after ``num_steps`` traced
+    steps or at ``close()``, and never re-opens (one window per run).
     """
 
     def __init__(self, log_dir: Optional[str], start: int = 5, num_steps: int = 5):
         self.log_dir = log_dir
         self.start = start
-        self.stop = start + num_steps
+        self.num_steps = num_steps
         self._active = False
+        self._stop_at: Optional[int] = None   # set when the window opens
 
-    def step(self, i: int) -> None:
-        if not self.log_dir:
-            return
-        if not self._active and i == self.start:
-            jax.profiler.start_trace(_host_dir(self.log_dir))
-            self._active = True
-        elif self._active and i >= self.stop:
-            jax.profiler.stop_trace()
-            self._active = False
+    def step(self, i: int):
+        if self.log_dir:
+            if (not self._active and self._stop_at is None
+                    and i >= self.start):
+                jax.profiler.start_trace(_host_dir(self.log_dir))
+                self._active = True
+                self._stop_at = i + self.num_steps
+            elif self._active and i >= self._stop_at:
+                jax.profiler.stop_trace()
+                self._active = False
+        if self._active:
+            return jax.profiler.StepTraceAnnotation("train", step_num=i)
+        return contextlib.nullcontext()
 
     def close(self) -> None:
         if self._active:
