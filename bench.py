@@ -88,6 +88,25 @@ def _build_parser():
                         "--model-flag fused_loss_pallas=0 for configs at "
                         "the HBM edge (the saved-logits buffer is the "
                         "marginal ~0.8 GB there)")
+    p.add_argument("--checkpoint-every", "--checkpoint_every", type=int,
+                   default=int(env("BENCH_CHECKPOINT_EVERY", "0")),
+                   help="save a checkpoint (async, utils/checkpoint.py "
+                        "AsyncSaver) every N measured steps into a temp dir "
+                        "— measures tok/s with checkpointing on and the "
+                        "checkpoint_save/commit_wait goodput split (0 = off)")
+    p.add_argument("--stream", action="store_true",
+                   help="synthesize batches on the fly on the host (through "
+                        "the host Prefetcher + DevicePrefetcher stack) "
+                        "instead of a pre-generated corpus — makes data_wait "
+                        "real so the prefetch overlap is measurable")
+    p.add_argument("--prefetch-depth", "--prefetch_depth", type=int,
+                   default=int(env("BENCH_PREFETCH_DEPTH", "2")),
+                   help="--stream: host-side prefetch depth (0 = synchronous)")
+    p.add_argument("--device-prefetch-depth", "--device_prefetch_depth",
+                   type=int,
+                   default=int(env("BENCH_DEVICE_PREFETCH_DEPTH", "2")),
+                   help="--stream: batches placed on device ahead of the "
+                        "step (0 = place inside the step)")
     p.add_argument("--jsonl", default=env("BENCH_JSONL"),
                    help="write the run's records (train windows, goodput, "
                         "comms_model) as schema-stamped JSONL here and run "
@@ -141,12 +160,17 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
               remat, mesh_cfg, strategy, devices=None, offload=False,
               offload_dtype="float32", num_experts=0, moe_top_k=1,
               model_flags=None, carry_cast=True,
-              opt_state_dtype="float32", offload_budget_gb=0.0):
+              opt_state_dtype="float32", offload_budget_gb=0.0,
+              checkpoint_every=0, stream=False, prefetch_depth=2,
+              device_prefetch_depth=2):
     """One measured config -> result dict. ``batch_size`` is per data shard
     (global batch scales with the mesh, the reference's DDP semantics)."""
     import jax
+    import numpy as np
 
+    from tpu_trainer.data.device_prefetch import DevicePrefetcher
     from tpu_trainer.data.dummy import create_dummy_dataloader
+    from tpu_trainer.data.prefetch import Prefetcher
     from tpu_trainer.models.config import GPTConfig
     from tpu_trainer.parallel.mesh import make_mesh
     from tpu_trainer.training.config import TrainingConfig
@@ -200,13 +224,46 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
                                      offload_budget_gb=offload_budget_gb),
                       mesh=mesh)
 
-    loader = create_dummy_dataloader(
-        batch_size=batch_size * accum * trainer.dp_size // trainer.process_count,
-        seq_len=seq_len,
-        vocab_size=model_config.vocab_size,
-        num_batches=5 * steps + 3,
-    )
-    it = iter(loader)
+    rows = batch_size * accum * trainer.dp_size // trainer.process_count
+    if stream:
+        # Streaming input mode: batches are synthesized per-pull on the host
+        # and flow through the full overlap stack (host Prefetcher thread →
+        # DevicePrefetcher placement), so data_wait measures whatever the
+        # overlap fails to hide instead of a pre-generated corpus's ~0.
+        def synth():
+            rng = np.random.default_rng(0)
+            while True:
+                yield rng.integers(
+                    0, model_config.vocab_size, size=(rows, seq_len),
+                    dtype=np.int32)
+
+        host_iter = iter(Prefetcher(synth, depth=prefetch_depth))
+        feed = DevicePrefetcher(
+            lambda: next(host_iter), place=trainer.place_batch,
+            depth=device_prefetch_depth)
+        next_batch = feed.next
+    else:
+        loader = create_dummy_dataloader(
+            batch_size=rows,
+            seq_len=seq_len,
+            vocab_size=model_config.vocab_size,
+            num_batches=5 * steps + 3,
+        )
+        it = iter(loader)
+        next_batch = lambda: next(it)  # noqa: E731
+
+    # Async checkpointing lane: save into a throwaway dir every
+    # checkpoint_every measured steps; the windows then price the snapshot
+    # (checkpoint_save) while the commit overlaps the following steps
+    # (residual drains show up as checkpoint_commit_wait).
+    saver = ckpt_dir = None
+    if checkpoint_every:
+        import tempfile
+
+        from tpu_trainer.utils import checkpoint as ckpt_lib
+
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        saver = ckpt_lib.AsyncSaver()
 
     ledger = telemetry_lib.GoodputLedger()
     state = trainer.init_state()
@@ -215,7 +272,7 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     # does not actually block, but a host read of a chained result does.
     with ledger.track("compile"):
         for _ in range(2):
-            state, metrics = trainer.train_step(state, next(it))
+            state, metrics = trainer.train_step(state, next_batch())
         float(metrics["loss"])
 
     # Five measured windows, keep the fastest: the shared/tunneled chip
@@ -226,17 +283,34 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     # tunnel block_until_ready does not block; a host read does).
     window_elapsed = []
     final_loss = None
+    measured = 0
     for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(steps):
             with ledger.track("data_wait"):
-                batch = next(it)
+                batch = next_batch()
             with ledger.track("step"):
                 state, metrics = trainer.train_step(state, batch)
+            measured += 1
+            if saver is not None and measured % checkpoint_every == 0:
+                if saver.in_flight:
+                    with ledger.track("checkpoint_commit_wait"):
+                        saver.wait()
+                with ledger.track("checkpoint_save"):
+                    saver.save(ckpt_dir, state,
+                               model_config=model_config,
+                               training_config=training_config,
+                               keep_last_n=2)
         with ledger.track("step"):  # the device wait lands here
             final_loss = float(metrics["loss"])  # end-of-window sync
         window_elapsed.append(time.perf_counter() - t0)
     elapsed = min(window_elapsed)
+    if saver is not None:
+        import shutil
+
+        with ledger.track("checkpoint_commit_wait"):
+            saver.wait()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     n_chips = mesh.size
     tokens = steps * trainer.tokens_per_step
@@ -299,6 +373,10 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         "offload": bool(trainer.cpu_offload),
         "opt_state_dtype": opt_state_dtype,
         "offload_dtype": offload_dtype if trainer.cpu_offload else None,
+        "checkpoint_every": checkpoint_every,
+        "stream": bool(stream),
+        "prefetch_depth": prefetch_depth if stream else None,
+        "device_prefetch_depth": device_prefetch_depth if stream else None,
         "elapsed_s": round(elapsed, 3),
         "window_elapsed_s": [round(w, 3) for w in window_elapsed],
         "tokens_per_window": tokens,
@@ -531,6 +609,9 @@ def main() -> None:
         carry_cast=bool(args.carry_cast),
         opt_state_dtype=args.opt_state_dtype,
         offload_budget_gb=args.offload_budget_gb,
+        checkpoint_every=args.checkpoint_every, stream=args.stream,
+        prefetch_depth=args.prefetch_depth,
+        device_prefetch_depth=args.device_prefetch_depth,
     )
     comms = detail.get("comms_model") or {}
     result = {
@@ -541,6 +622,15 @@ def main() -> None:
         # Additive observability fields (ISSUE 2): measured-loop goodput
         # and XLA-predicted vs analytic FLOPs for the compiled step.
         "goodput_productive_frac": detail["goodput"].get("productive_frac"),
+        # Overlap split (ISSUE 4): with --checkpoint_every the save frac is
+        # the snapshot cost only (the commit overlaps compute; residual
+        # drains land in commit_wait); with --stream + prefetch, data_wait
+        # should sit at ~0.
+        "goodput_data_wait_frac": detail["goodput"].get("data_wait_frac"),
+        "goodput_checkpoint_save_frac": detail["goodput"].get(
+            "checkpoint_save_frac"),
+        "goodput_checkpoint_commit_wait_frac": detail["goodput"].get(
+            "checkpoint_commit_wait_frac"),
         "xla_flops_per_step": detail["xla_flops_per_step"],
         "analytic_flops_per_step": detail["analytic_flops_per_step"],
         # Static comms/compute split of the measured config (ISSUE 3).

@@ -112,9 +112,19 @@ class TestPrefetcher:
         )
         assert list(it) == list(range(1, 8))
 
-    def test_zero_depth_rejected(self):
+    def test_negative_depth_rejected(self):
         with pytest.raises(ValueError):
-            Prefetcher(lambda: iter([]), depth=0)
+            Prefetcher(lambda: iter([]), depth=-1)
+
+    def test_zero_depth_is_synchronous_passthrough(self):
+        # depth=0 means "no thread, no buffer" — the knob degrades to the
+        # plain iterator so call sites never branch on it.
+        import threading
+
+        before = threading.active_count()
+        assert list(Prefetcher(lambda: iter(range(5)), depth=0)) == \
+            list(range(5))
+        assert threading.active_count() == before
 
 
 @pytest.fixture

@@ -1,0 +1,114 @@
+"""Sharded device prefetch: keep the next batches already on the chips.
+
+``data/prefetch.py`` overlaps *host* work (tokenization, batch assembly)
+with device compute, but the host→device transfer itself still happened
+synchronously inside the step (``Trainer.place_batch`` →
+``jax.make_array_from_process_local_data``). On TPU that H2D copy is DMA
+the device could hide under the previous step's compute — but only if the
+transfer is *enqueued* before the step needs it. ``DevicePrefetcher`` pulls
+``depth`` batches ahead of the trainer and places each with the batch
+sharding immediately; jax's async dispatch returns as soon as the copy is
+enqueued, so by the time the trainer asks for batch N it is (or is about
+to be) resident, and the goodput ledger's ``data_wait`` drops to ~0.
+
+No thread lives here: placement is async already, and a thread would buy
+nothing but reordering hazards. Layering for a streaming run::
+
+    TextDataLoader -> Prefetcher (host thread) -> DevicePrefetcher -> step
+
+Cursor contract (what makes resume/rollback stay bit-exact): the wrapped
+loader's ``state_dict()`` cursor advances when a batch leaves the *loader*
+— which, with a prefetch buffer, is up to ``depth`` batches ahead of what
+the trainer actually consumed. Checkpointing that would over-advance the
+cursor and a resumed run would silently skip the buffered batches. So this
+class snapshots the loader cursor at each pull and republishes, via its own
+``state_dict()``, the snapshot belonging to the batch most recently handed
+to the trainer. The checkpoint/rollback paths read the feed's cursor, never
+the raw loader's, and "consumed" keeps meaning "consumed by the trainer".
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Optional
+
+
+class DevicePrefetcher:
+    """Pull batches from ``next_fn``, place them on device ahead of use.
+
+    - ``next_fn``: returns the next host batch (may block on the host
+      pipeline; ``StopIteration`` ends the stream).
+    - ``place``: host batch → sharded device array, enqueued async
+      (``Trainer.place_batch``).
+    - ``cursor_fn``: the wrapped loader's ``state_dict`` (optional); see
+      the module docstring for the republishing contract.
+    - ``depth``: batches kept placed ahead of the trainer; ``0`` degrades
+      to synchronous place-on-demand (identical to the pre-prefetch loop).
+    """
+
+    def __init__(
+        self,
+        next_fn: Callable[[], object],
+        *,
+        place: Callable[[object], object],
+        cursor_fn: Optional[Callable[[], dict]] = None,
+        depth: int = 2,
+    ):
+        if depth < 0:
+            raise ValueError(f"device prefetch depth must be >= 0, got {depth}")
+        self._next_fn = next_fn
+        self._place = place
+        self._cursor_fn = cursor_fn
+        self.depth = depth
+        self._buf: collections.deque = collections.deque()
+        self._exhausted = False
+        # Until the trainer consumes a batch, the feed's cursor is the
+        # loader's cursor from before anything was pulled.
+        self._cursor = cursor_fn() if cursor_fn is not None else None
+
+    def _pull(self) -> bool:
+        try:
+            batch = self._next_fn()
+        except StopIteration:
+            self._exhausted = True
+            return False
+        # Cursor first, then place: the snapshot must describe "this batch
+        # consumed", and place() only enqueues a copy anyway.
+        cur = self._cursor_fn() if self._cursor_fn is not None else None
+        self._buf.append((self._place(batch), cur))
+        return True
+
+    def _fill(self) -> None:
+        while not self._exhausted and len(self._buf) < max(self.depth, 1):
+            self._pull()
+
+    def next(self):
+        """The next device-resident batch; advances the published cursor to
+        this batch's snapshot. Raises ``StopIteration`` when the stream is
+        exhausted and the buffer is drained."""
+        if not self._buf:
+            self._fill()
+        if not self._buf:
+            raise StopIteration
+        batch, cur = self._buf.popleft()
+        self._cursor = cur
+        # Top back up now so the next H2D copies run under this step's
+        # compute, not in its data_wait.
+        self._fill()
+        return batch
+
+    def state_dict(self) -> Optional[dict]:
+        """Loader cursor of the last batch the *trainer* consumed — buffered
+        batches are excluded, so a checkpoint taken now resumes by replaying
+        exactly the batches still in flight."""
+        return self._cursor
+
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def reset(self) -> None:
+        """Drop the buffer and re-base the cursor on the (re-wound) loader —
+        call after ``load_state_dict`` on the wrapped loader, e.g. rollback."""
+        self._buf.clear()
+        self._exhausted = False
+        self._cursor = self._cursor_fn() if self._cursor_fn is not None else None

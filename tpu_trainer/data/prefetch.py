@@ -12,6 +12,12 @@ device consumes batch N while the host builds batch N+1.
 Threads (not processes) suffice here: the heavy per-item work — HF fast
 tokenizers (Rust) and the native byte-tokenize kernel — releases the GIL,
 and device dispatch overlaps regardless.
+
+This layer is host-side only; the host→device copy is overlapped one layer
+up by ``data/device_prefetch.py``, which places the next batches with the
+batch sharding while the current step computes. Full streaming stack::
+
+    TextDataLoader -> Prefetcher (this, host) -> DevicePrefetcher -> step
 """
 
 from __future__ import annotations
@@ -48,12 +54,17 @@ class Prefetcher:
     _SENTINEL = object()
 
     def __init__(self, make_iter: Callable[[], Iterable], depth: int = 2):
-        if depth <= 0:
-            raise ValueError(f"prefetch depth must be positive, got {depth}")
+        if depth < 0:
+            raise ValueError(f"prefetch depth must be >= 0, got {depth}")
         self._make_iter = make_iter
         self._depth = depth
 
     def __iter__(self) -> Iterator:
+        if self._depth == 0:
+            # Passthrough: no thread, no buffer — lets call sites treat the
+            # depth as a plain knob (0 = synchronous) instead of branching.
+            yield from self._make_iter()
+            return
         q: queue.Queue = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
 

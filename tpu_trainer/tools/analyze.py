@@ -266,12 +266,18 @@ def render(report: dict) -> List[str]:
 
 def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             mfu_tol: float = 0.10, mem_tol: float = 0.10,
-            loss_tol: float = 0.05) -> List[dict]:
+            loss_tol: float = 0.05, overhead_tol: float = 0.10) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
     tok/s loss fails the default gate); metrics absent from either run
     SKIP (CPU runs have no MFU or HBM) — SKIP never fails CI.
+
+    ``overlap_overhead`` is the one ABSOLUTE gate: the goodput share lost
+    to ``checkpoint_save + data_wait``. The overlap engine (ISSUE 4) exists
+    to keep that share near zero, so a run whose combined share grows by
+    >= ``overhead_tol`` (fraction-of-wall-clock points, not relative — a
+    0.1% -> 0.2% doubling is noise, 2% -> 12% is a broken overlap) FAILs.
     """
     def get(report, *keys):
         cur = report
@@ -305,6 +311,31 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "delta_pct": round(delta * 100, 2),
             "tolerance_pct": round(tol * 100, 2),
         })
+
+    def overhead(report):
+        fr = get(report, "goodput", "fractions")
+        if fr is None:
+            return None
+        vals = [fr.get("checkpoint_save"), fr.get("data_wait")]
+        if all(v is None for v in vals):
+            return None
+        return sum(v for v in vals if v is not None)
+
+    b, n = overhead(base), overhead(new)
+    if b is None or n is None:
+        verdicts.append({"metric": "overlap_overhead", "verdict": "SKIP",
+                         "base": b, "new": n})
+    else:
+        delta = n - b  # absolute, in fraction-of-wall-clock points
+        verdicts.append({
+            "metric": "overlap_overhead",
+            "verdict": "FAIL" if delta >= overhead_tol - eps else "PASS",
+            "base": round(b, 4),
+            "new": round(n, 4),
+            "delta_pct": round(delta * 100, 2),
+            "tolerance_pct": round(overhead_tol * 100, 2),
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -314,10 +345,11 @@ def render_verdicts(verdicts: List[dict]) -> List[str]:
         if v["verdict"] == "SKIP":
             lines.append(f"SKIP {v['metric']:<16} (absent in one run)")
         else:
+            kind = " abs" if v.get("absolute") else ""
             lines.append(
                 f"{v['verdict']} {v['metric']:<16} base {_fmt(v['base'], 4)}"
-                f" new {_fmt(v['new'], 4)} ({v['delta_pct']:+.1f}%,"
-                f" tol {v['tolerance_pct']:.0f}%)")
+                f" new {_fmt(v['new'], 4)} ({v['delta_pct']:+.1f}%{kind},"
+                f" tol {v['tolerance_pct']:.0f}%{kind})")
     return lines
 
 
@@ -334,6 +366,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--mfu-tol", type=float, default=0.10)
     parser.add_argument("--mem-tol", type=float, default=0.10)
     parser.add_argument("--loss-tol", type=float, default=0.05)
+    parser.add_argument("--overhead-tol", type=float, default=0.10,
+                        help="ABSOLUTE gate on the checkpoint_save + "
+                             "data_wait goodput share: FAIL if the new "
+                             "run's share grows by >= this many fraction-"
+                             "of-wall-clock points (default 0.10)")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -353,7 +390,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         verdicts = compare(
             base_report, report, tok_tol=args.tok_tol, mfu_tol=args.mfu_tol,
-            mem_tol=args.mem_tol, loss_tol=args.loss_tol)
+            mem_tol=args.mem_tol, loss_tol=args.loss_tol,
+            overhead_tol=args.overhead_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from tpu_trainer.data.device_prefetch import DevicePrefetcher
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.parallel import comms_model as comms_lib
 from tpu_trainer.parallel import mesh as mesh_lib
@@ -51,6 +52,30 @@ from tpu_trainer.utils.logging import MetricLogger, flops_per_token
 # Steps between cross-host preemption votes (each vote is a collective, so
 # it must run at a cadence every host reaches at the same step).
 _PREEMPT_VOTE_INTERVAL = 10
+
+# Steps a metric future may stay in flight before the host materializes it
+# (utils/telemetry.py DeferredFetcher): by fetch time the device has long
+# finished that step, so the device_get returns ~immediately; the spike
+# detector and NaN guards see values this many steps late, which recovery
+# (bounded by checkpoint cadence, not by the window) absorbs.
+_DEFERRED_SYNC_WINDOW = 2
+
+
+def _nan_loss_transform(metrics: dict) -> dict:
+    """Injected-fault mutation, applied to the *fetched* host copy at
+    maturity — the live metrics are still in flight on device when the
+    fault fires."""
+    metrics = dict(metrics)
+    metrics["loss"] = float("nan")
+    return metrics
+
+
+def _loss_spike_transform(metrics: dict) -> dict:
+    # Large but finite: the early-warning path must engage before anything
+    # trips the NaN guard.
+    metrics = dict(metrics)
+    metrics["loss"] = float(metrics["loss"]) * 8.0 + 5.0
+    return metrics
 
 _SHARDING_CHOICES = [
     "FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD",
@@ -107,9 +132,20 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--num_workers", type=int, default=None,
                    help="streaming tokenizer thread-pool size (0 = inline; "
                         "reference DataLoader num_workers)")
-    p.add_argument("--prefetch", type=int, default=None,
-                   help="batches assembled ahead on a background thread "
-                        "(0 disables the input/compute overlap)")
+    p.add_argument("--prefetch", "--prefetch_depth", dest="prefetch",
+                   type=int, default=None,
+                   help="host-side prefetch depth: batches assembled ahead "
+                        "on a background thread (0 disables the host "
+                        "input/compute overlap)")
+    p.add_argument("--device_prefetch_depth", type=int, default=None,
+                   help="batches placed on device (with the batch sharding) "
+                        "ahead of the step so H2D copies ride under compute "
+                        "(default 2; 0 places inside the step)")
+    p.add_argument("--no_async_checkpointing", action="store_true",
+                   default=None,
+                   help="commit interval checkpoints synchronously in the "
+                        "step loop instead of snapshotting to host and "
+                        "writing on a background thread")
     p.add_argument("--num_batches", type=int, default=None,
                    help="dummy-dataset corpus size in batches")
     p.add_argument("--tokenizer", type=str, default=None)
@@ -373,6 +409,16 @@ def resolve_configs(args, mode: str):
                              defaults.checkpoint_dir),
         resume_from=_pick(args.resume_from, y_ckpt.get("resume_from")),
         seed=_picki(args.seed, y_train.get("seed"), defaults.seed),
+        # Step overlap (ISSUE 4): one resolved value each, read from here by
+        # both the loaders and the startup summary.
+        prefetch_depth=_picki(args.prefetch, y_data.get("prefetch"),
+                              defaults.prefetch_depth),
+        device_prefetch_depth=_picki(args.device_prefetch_depth,
+                                     y_data.get("device_prefetch"),
+                                     defaults.device_prefetch_depth),
+        async_checkpointing=bool(_pick(
+            False if args.no_async_checkpointing else None,
+            y_ckpt.get("async"), defaults.async_checkpointing)),
     )
 
     # --- parallelism ---------------------------------------------------
@@ -425,7 +471,8 @@ def resolve_configs(args, mode: str):
         "cache_max_tokens": _pick(args.cache_max_tokens,
                                   y_data.get("cache_max_tokens")),
         "num_workers": _pick(args.num_workers, y_data.get("num_workers"), 0),
-        "prefetch": _pick(args.prefetch, y_data.get("prefetch"), 2),
+        "prefetch": training_config.prefetch_depth,
+        "device_prefetch": training_config.device_prefetch_depth,
         "num_batches": _pick(args.num_batches, 100),
         "tokenizer": _pick(args.tokenizer, y_data.get("tokenizer"), "gpt2"),
         "metrics_jsonl": args.metrics_jsonl,
@@ -563,6 +610,10 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         print(f"model: {model_config.num_parameters():,} params | "
               f"global batch {trainer.global_batch_size} seqs x "
               f"{training_config.max_seq_len} tokens")
+        print(f"overlap: host_prefetch={training_config.prefetch_depth} "
+              f"device_prefetch={training_config.device_prefetch_depth} "
+              f"async_checkpointing="
+              f"{'on' if training_config.async_checkpointing else 'off'}")
         if trainer.cpu_offload and trainer.offload_resident_bytes:
             print(f"partial offload: "
                   f"{trainer.offload_resident_bytes / 2**30:.2f} GB of "
@@ -708,17 +759,39 @@ def run_training(argv=None, mode: str = "ddp") -> int:
 
     old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
 
-    def save(tag: str = ""):
+    # Async checkpointing (ISSUE 4): the periodic save snapshots to host and
+    # returns; shards + meta commit on the saver's writer thread. At most one
+    # commit is in flight — the next save, a rollback, SIGTERM, and exit all
+    # drain it first, and that wait is attributed to checkpoint_commit_wait
+    # (in steady state the commit finishes under the following steps' compute
+    # and the drain costs ~nothing).
+    saver = ckpt_lib.AsyncSaver() if training_config.async_checkpointing else None
+
+    def drain_save():
+        if saver is not None and saver.in_flight:
+            with ledger.track("checkpoint_commit_wait"):
+                saver.wait()
+
+    def save(tag: str = "", wait: bool = False):
+        drain_save()
         with ledger.track("checkpoint_save"):
-            data_sd = (train_loader.state_dict()
-                       if hasattr(train_loader, "state_dict") else None)
-            path = ckpt_lib.save_checkpoint(
+            # The feed's cursor, not the raw loader's: with device prefetch
+            # the loader runs up to depth batches ahead of what the trainer
+            # consumed, and resuming from its cursor would skip the
+            # buffered batches.
+            data_sd = feed.state_dict()
+            save_fn = saver.save if saver is not None else ckpt_lib.save_checkpoint
+            path = save_fn(
                 training_config.checkpoint_dir, state,
                 model_config=model_config, training_config=training_config,
                 tokens_seen=logger.tokens_seen,
                 data_state=data_sd,
                 keep_last_n=data_opts["keep_last_n"],
             )
+        if wait:
+            # Terminal saves (final/preempt/crash): the process is about to
+            # exit, so the checkpoint must be durable before we return.
+            drain_save()
         if main:
             print(f"saved checkpoint{' (' + tag + ')' if tag else ''}: {path}")
 
@@ -732,7 +805,12 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             for i, batch in enumerate(eval_loader):
                 if i >= data_opts["eval_batches"]:
                     break
-                losses.append(float(trainer.eval_step(state, batch)))
+                # Device value: each eval step dispatches async and the
+                # loop keeps feeding; the single device_get below is the
+                # only host sync for the whole eval pass (the old
+                # per-batch float() serialized host and device).
+                losses.append(trainer.eval_step(state, batch))
+            losses = [float(x) for x in jax.device_get(losses)]
         if losses and main:
             logger.log_eval(int(state.step), float(np.mean(losses)),
                             len(losses))
@@ -765,6 +843,21 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     "dataset or reduce batch_size/grad_accum."
                 ) from None
 
+    # Device prefetch (ISSUE 4): the feed owns the trainer-consumed cursor
+    # (data/device_prefetch.py docstring) — every checkpoint/rollback reads
+    # feed.state_dict(), never the raw loader's. place binds late so an LR
+    # backoff's rebuilt trainer is picked up without respawning the feed.
+    def make_feed():
+        return DevicePrefetcher(
+            next_batch,
+            place=lambda b: trainer.place_batch(b),
+            cursor_fn=(train_loader.state_dict
+                       if hasattr(train_loader, "state_dict") else None),
+            depth=data_opts["device_prefetch"],
+        )
+
+    feed = make_feed()
+
     profiler = profiling.WindowedTrace(
         data_opts["profile_dir"],
         start=int(state.step) + data_opts["profile_start"],
@@ -782,11 +875,42 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     base_lr = training_config.learning_rate
 
     # Telemetry cadence + loss-spike early warning (ISSUE 2). The spike
-    # check reads only records the logger actually emitted (``record is not
-    # None``) so steady-state steps never force a device sync.
+    # check reads only records the logger actually emitted, and (ISSUE 4)
+    # only on matured — window-lagged — host values.
     telemetry_interval = data_opts["telemetry_interval"]
     spike = (telemetry_lib.SpikeDetector(sigma=data_opts["spike_sigma"])
              if data_opts["spike_sigma"] > 0 else None)
+    # Deferred host sync (ISSUE 4): each step's metrics go into a bounded
+    # window of in-flight futures instead of being read back immediately;
+    # the logger/spike/guard consumers below run on matured (lagged) host
+    # values, so the host never blocks on the step it just dispatched.
+    deferred = telemetry_lib.DeferredFetcher(window=_DEFERRED_SYNC_WINDOW)
+
+    def consume(entries, check: bool = True):
+        """Log, spike-check, and guard each matured metric entry.
+        ``check=False`` (exit paths) logs without raising."""
+        for mstep, mmetrics in entries:
+            rec = logger.log(mstep, mmetrics)
+            if not check:
+                continue
+            if spike is not None and rec is not None:
+                is_spike, z = spike.update(rec["loss"])
+                if is_spike:
+                    if main:
+                        print(
+                            f"loss spike at step {mstep}: loss "
+                            f"{rec['loss']:.4f} is z={z:.1f} above "
+                            f"the rolling median (sigma="
+                            f"{data_opts['spike_sigma']:g}); rolling "
+                            "back before divergence", flush=True)
+                    raise guards.LossSpikeError(
+                        f"loss spike (z={z:.1f}) at step {mstep}")
+            if guard_interval and (mstep + 1) % guard_interval == 0:
+                loss = (rec or {}).get("loss")
+                if loss is None:
+                    loss = float(mmetrics["loss"])
+                guards.check_finite(mstep, loss)
+                guards.check_hosts_in_sync(mstep, loss)
     # Goodput attribution: the first execution of each jitted step variant
     # pays tracing + XLA compilation, so its wall-clock goes to "compile";
     # later executions go to "step" (or "rollback_replay" while re-covering
@@ -813,7 +937,10 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     # viewer), a nullcontext outside it.
                     with profiler.step(step):
                         with ledger.track("data_wait"):
-                            batch = next_batch()
+                            # Device-resident (or at least enqueued) already
+                            # when device_prefetch_depth > 0 — the H2D copy
+                            # ran under the previous step's compute.
+                            batch = feed.next()
                         tel_step = bool(
                             telemetry_interval
                             and (step + 1) % telemetry_interval == 0)
@@ -822,10 +949,10 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                         category = ("compile" if expected_compile
                                     else "rollback_replay"
                                     if step <= replay_until else "step")
-                        # The logger's loss read is the device sync point,
-                        # so it stays inside the tracked block — otherwise
-                        # async dispatch would bank the real compute under
-                        # "untracked".
+                        # The matured metric fetches are the device sync
+                        # point, so they stay inside the tracked block —
+                        # otherwise async dispatch would bank the real
+                        # compute under "untracked".
                         with ledger.track(category):
                             state, metrics = trainer.train_step(
                                 state, batch, telemetry=tel_step)
@@ -833,17 +960,12 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                                 jax.block_until_ready(metrics["loss"])
                                 jit_warm[variant] = True
                             steps_this_run += 1
+                            transform = None
                             if faults.fire("nan_loss", step):
-                                metrics = dict(metrics)
-                                metrics["loss"] = float("nan")
+                                transform = _nan_loss_transform
                             if faults.fire("loss_spike", step):
-                                # Large but finite: the early-warning path
-                                # must engage before anything trips the NaN
-                                # guard.
-                                metrics = dict(metrics)
-                                metrics["loss"] = (
-                                    float(metrics["loss"]) * 8.0 + 5.0)
-                            record = logger.log(step, metrics)
+                                transform = _loss_spike_transform
+                            consume(deferred.push(step, metrics, transform))
                     wd_rec = watchdog.observe(step, batch,
                                               expected=expected_compile)
                     if wd_rec is not None:
@@ -905,30 +1027,21 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                                     print("comms_model failed: "
                                           f"{type(comms_err).__name__}: "
                                           f"{comms_err}", flush=True)
-                    if spike is not None and record is not None:
-                        is_spike, z = spike.update(record["loss"])
-                        if is_spike:
-                            if main:
-                                print(
-                                    f"loss spike at step {step}: loss "
-                                    f"{record['loss']:.4f} is z={z:.1f} above "
-                                    f"the rolling median (sigma="
-                                    f"{data_opts['spike_sigma']:g}); rolling "
-                                    "back before divergence", flush=True)
-                            raise guards.LossSpikeError(
-                                f"loss spike (z={z:.1f}) at step {step}")
                     if tel_step:
                         logger.log_record(ledger.record(step=step))
-                    if guard_interval and (step + 1) % guard_interval == 0:
-                        loss = (record or {}).get("loss", float(metrics["loss"]))
-                        guards.check_finite(step, loss)
-                        guards.check_hosts_in_sync(step, loss)
                     eval_now = (training_config.eval_interval > 0
                                 and (step + 1) % training_config.eval_interval == 0)
+                    save_now = (training_config.save_interval > 0
+                                and (step + 1) % training_config.save_interval == 0)
+                    if eval_now or save_now:
+                        # Boundary: materialize outstanding metric futures
+                        # so eval records order after the train records and
+                        # the checkpoint's tokens_seen count is exact (the
+                        # eval/snapshot sync pays the device wait anyway).
+                        consume(deferred.drain())
                     if eval_now:
                         run_eval()
-                    if (training_config.save_interval > 0
-                            and (step + 1) % training_config.save_interval == 0):
+                    if save_now:
                         save()
                     # The preempt decision must be unanimous: the checkpoint
                     # save is a collective, so one host's SIGTERM pulls every
@@ -941,10 +1054,12 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     if vote_now and mesh_lib.global_any(preempted["hit"]):
                         if main:
                             print("SIGTERM received: checkpointing and exiting")
-                        save("preempt")
+                        consume(deferred.drain(), check=False)
+                        save("preempt", wait=True)
                         dump_flight("sigterm")
                         return 143
-                save("final")
+                consume(deferred.drain())
+                save("final", wait=True)
                 if not (training_config.eval_interval > 0
                         and step + 1 == training_config.max_steps
                         and (step + 1) % training_config.eval_interval == 0):
@@ -956,11 +1071,11 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                         print(f"divergence persisted after {rollbacks} "
                               f"rollback(s); giving up", flush=True)
                     raise
-                # The cursor at failure points just past the offending batch;
-                # capture it before the restore below rewinds the loader.
-                failure_cursor = (train_loader.state_dict()
-                                  if hasattr(train_loader, "state_dict")
-                                  else None)
+                # The feed's cursor at failure points just past the last
+                # batch the trainer consumed (with deferred sync, up to the
+                # window past the batch that actually diverged); capture it
+                # before the restore below rewinds the loader.
+                failure_cursor = feed.state_dict()
                 rollbacks += 1
                 backoff = data_opts["rollback_lr_backoff"] ** rollbacks
                 if backoff != 1.0:
@@ -978,7 +1093,15 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     # stale history would re-fire on the first post-rollback
                     # loss and burn the rollback budget.
                     spike.reset()
+                # Un-matured metric futures predate the rollback: reading
+                # them now would log pre-failure steps after the rollback
+                # record; drop them (the window is a few steps of logs).
+                deferred = telemetry_lib.DeferredFetcher(
+                    window=_DEFERRED_SYNC_WINDOW)
                 replay_until = step  # re-covered ground is not fresh goodput
+                # An in-flight async commit may be writing (and GC-ing) the
+                # very tree restore_latest is about to scan: drain it first.
+                drain_save()
                 with ledger.track("checkpoint_restore"):
                     restored = ckpt_lib.restore_latest(
                         training_config.checkpoint_dir, trainer, verify=True)
@@ -1002,6 +1125,9 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 if hasattr(data_iter, "close"):
                     data_iter.close()
                 data_iter = iter(train_loader)
+                # Buffered batches belong to the abandoned timeline; a fresh
+                # feed re-bases its cursor on the rewound loader.
+                feed = make_feed()
                 # The rebuilt trainer (LR backoff) has a fresh executable
                 # cache; re-arm the watchdog on it either way so the
                 # watermark matches the trainer actually stepping.
@@ -1035,12 +1161,22 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         # (an immediate failure would just overwrite good state with noise).
         if steps_this_run >= 1:
             try:
-                save("crash")
+                save("crash", wait=True)
             except Exception as save_err:
                 if main:
                     print(f"crash checkpoint failed: {save_err}", flush=True)
         raise
     finally:
+        if saver is not None and saver.in_flight:
+            # Exception exits that skipped the terminal-save drains: let the
+            # last scheduled checkpoint land rather than orphaning it
+            # (best-effort — an incomplete tree is crash-safe regardless).
+            try:
+                drain_save()
+            except Exception as commit_err:
+                if main:
+                    print(f"async checkpoint commit failed: {commit_err}",
+                          flush=True)
         signal.signal(signal.SIGTERM, old_handler)
         profiler.close()
         logger.close()

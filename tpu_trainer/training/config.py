@@ -69,6 +69,17 @@ class TrainingConfig:
     # Gradient accumulation (reference ddp_trainer.py:58)
     gradient_accumulation_steps: int = 4
 
+    # Step overlap (ISSUE 4). prefetch_depth: host-side batches assembled
+    # ahead on the Prefetcher thread (0 = synchronous). device_prefetch_depth:
+    # batches jax.device_put ahead with the batch sharding so H2D rides under
+    # the previous step's compute (0 = place inside the step, the old
+    # behavior). async_checkpointing: save_interval checkpoints snapshot to
+    # host and commit on a background writer (utils/checkpoint.py AsyncSaver);
+    # at most one save in flight, crash-safety contract unchanged.
+    prefetch_depth: int = 2
+    device_prefetch_depth: int = 2
+    async_checkpointing: bool = True
+
     # Checkpointing (reference ddp_trainer.py:61-63). resume_from is consumed
     # by the training CLI entrypoints (tpu_trainer.training.train), which also
     # auto-resume from the latest checkpoint in checkpoint_dir — the

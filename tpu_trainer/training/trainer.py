@@ -699,10 +699,12 @@ class Trainer:
 
     # --- the step -----------------------------------------------------------
 
-    def _place_batch(self, batch) -> jax.Array:
+    def place_batch(self, batch) -> jax.Array:
         """Host array ``[accum * local_bs, seq]`` (or ``[accum, local_bs,
         seq]``) → the sharded ``[accum, global_bs, seq]`` device array the
-        jitted step expects; device arrays pass through."""
+        jitted step expects; device arrays pass through. Public: the
+        device-prefetch feed (``data/device_prefetch.py``) uses this to
+        enqueue H2D copies ahead of the step."""
         if not isinstance(batch, jax.Array):
             batch = np.asarray(batch)
             if batch.ndim == 3:
@@ -716,14 +718,14 @@ class Trainer:
 
         ``batch``: the sharded ``[accum, global_bs, seq]`` device array from
         ``put_batch``, or a **process-local** host array, which is placed
-        automatically (``_place_batch``).
+        automatically (``place_batch``).
 
         ``telemetry=True`` runs the telemetry variant of the step (separate
         executable, compiled on first use): the metrics dict gains a
         ``"telemetry"`` subtree of per-layer grad/param/update norms,
         activation RMS/absmax, and MoE router stats.
         """
-        batch = self._place_batch(batch)
+        batch = self.place_batch(batch)
         if telemetry:
             return self._step_tel_jit(state, batch)
         return self._step_jit(state, batch)
@@ -740,7 +742,7 @@ class Trainer:
         it is counted once). Returns None when the backend doesn't expose the
         analysis.
         """
-        batch = self._place_batch(batch)
+        batch = self.place_batch(batch)
         # Same jit object + same shapes as the running step: this hits the
         # existing executable cache rather than recompiling.
         compiled = self._step_jit.lower(state, batch).compile()
@@ -772,7 +774,7 @@ class Trainer:
         charges the model for padding and recompute the 6N estimate misses.
         Returns None when the backend hides the analysis.
         """
-        batch = self._place_batch(batch)
+        batch = self.place_batch(batch)
         # Same jit object + shapes as the running step: hits the executable
         # cache (or warms it — this doubles as an explicit compile point the
         # goodput ledger can attribute to "compile").
@@ -803,7 +805,7 @@ class Trainer:
         object + shapes as the running step, so this hits the executable
         cache rather than recompiling.
         """
-        batch = self._place_batch(batch)
+        batch = self.place_batch(batch)
         try:
             return self._step_jit.lower(state, batch).compile().as_text()
         except Exception:
@@ -835,7 +837,7 @@ class Trainer:
         utils/telemetry.nan_report. Debug tool (``--nan_scan``); the
         activation hooks don't run under pipeline schedules (stage > 1).
         """
-        batch = self._place_batch(batch)
+        batch = self.place_batch(batch)
 
         def scan_fn(st, micro):
             with telemetry.capture(deep=True) as cap:

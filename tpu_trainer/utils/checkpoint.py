@@ -41,6 +41,7 @@ import os
 import re
 import shutil
 import sys
+import threading
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -50,7 +51,7 @@ import orbax.checkpoint as ocp
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.parallel.mesh import barrier
 from tpu_trainer.training.config import TrainingConfig
-from tpu_trainer.utils import faults
+from tpu_trainer.utils import faults, jax_compat
 
 _STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
 
@@ -178,9 +179,58 @@ def save_checkpoint(
         # the on-disk format identical to pre-carry checkpoints and saves
         # the copy's bytes; restore_checkpoint rebuilds it.
         state = state.replace(params_c=None)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "state"), state, force=True)
-    ckptr.wait_until_finished()
+    _commit_checkpoint(
+        checkpoint_dir,
+        path,
+        state,
+        step=step,
+        model_config=model_config,
+        training_config=training_config,
+        tokens_seen=tokens_seen,
+        data_state=data_state,
+        keep_last_n=keep_last_n,
+        use_async_writer=False,
+    )
+    return path
+
+
+def _commit_checkpoint(
+    checkpoint_dir: str,
+    path: str,
+    state_like,
+    *,
+    step: int,
+    model_config: GPTConfig,
+    training_config: TrainingConfig,
+    tokens_seen: int,
+    data_state: Optional[dict],
+    keep_last_n: int,
+    use_async_writer: bool,
+) -> None:
+    """The durable half of a save, shared by the sync path and AsyncSaver's
+    writer thread: write every shard, fire the ``kill_in_save`` fault in the
+    window where shards are durable but meta is not, commit meta.json
+    (host 0), then GC. ``state_like`` is a TrainState of jax arrays (sync
+    path) or its ``jax.device_get`` host snapshot (async path) — orbax
+    writes both to the same logical tree and restore reshards either onto
+    the restoring trainer's mesh."""
+    state_path = os.path.join(path, "state")
+    if use_async_writer and jax_compat.ORBAX_ASYNC_OK:
+        # Orbax's own async machinery, when this version has it. We still
+        # wait for durability here — the *caller* is the background thread,
+        # so the step loop never sees this wait — because meta.json must
+        # not land before every shard is on disk.
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        try:
+            ckptr.save(state_path, args=ocp.args.StandardSave(state_like),
+                       force=True)
+            ckptr.wait_until_finished()
+        finally:
+            ckptr.close()
+    else:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(state_path, state_like, force=True)
+        ckptr.wait_until_finished()
     barrier("checkpoint_save")
     if faults.fire("kill_in_save", step):
         # Injected crash between the shard writes and the meta write: the
@@ -204,7 +254,104 @@ def save_checkpoint(
         _corrupt_some_shard(path)
     if keep_last_n > 0:
         gc_checkpoints(checkpoint_dir, keep_last_n)
-    return path
+
+
+class AsyncSaver:
+    """Background checkpoint writer: snapshot now, commit later.
+
+    ``save()`` blocks only for the device→host copy of the train state (the
+    *snapshot* — mandatory anyway, because ``train_step`` donates the state
+    buffers and the very next step would overwrite what orbax is reading),
+    then hands the host tree to a writer thread that runs the same commit
+    sequence as :func:`save_checkpoint`: shards → ``kill_in_save`` fault
+    window → meta.json → GC. The crash-safety contract is unchanged — a
+    checkpoint is complete iff meta.json parses, and an injected or real
+    death mid-commit leaves a meta-less tree that every scan ignores.
+
+    At most one save is in flight: ``save()`` drains the previous commit
+    first (callers attribute that wait to ``checkpoint_commit_wait`` in the
+    goodput ledger), and rollback/SIGTERM/exit paths call ``wait()`` before
+    restoring or returning. The writer is a daemon thread, so an injected
+    ``kill_in_save`` (``os._exit``) or a real SIGKILL dies exactly like the
+    sync path — mid-commit, meta unwritten.
+
+    Multi-process runs fall back to the synchronous path: the host snapshot
+    can only see addressable shards, and cross-host barriers from a
+    background thread would race the main thread's collectives.
+    """
+
+    def __init__(self) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._path: Optional[str] = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait(self) -> Optional[str]:
+        """Drain the in-flight commit (if any); returns its path. Re-raises
+        a writer-thread failure here, on the step loop's thread, so a bad
+        disk surfaces as a crash-with-traceback instead of silent loss of
+        every subsequent checkpoint."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        return self._path
+
+    def save(
+        self,
+        checkpoint_dir: str,
+        state,
+        *,
+        model_config: GPTConfig,
+        training_config: TrainingConfig,
+        tokens_seen: int = 0,
+        data_state: Optional[dict] = None,
+        keep_last_n: int = 0,
+    ) -> str:
+        """Snapshot ``state`` to host and schedule the commit; returns the
+        checkpoint path (which is complete only once the commit lands —
+        ``wait()`` to require it)."""
+        if jax.process_count() > 1:
+            return save_checkpoint(
+                checkpoint_dir, state,
+                model_config=model_config, training_config=training_config,
+                tokens_seen=tokens_seen, data_state=data_state,
+                keep_last_n=keep_last_n,
+            )
+        self.wait()
+        if getattr(state, "params_c", None) is not None:
+            state = state.replace(params_c=None)
+        # The snapshot: blocks until every pending step that writes into
+        # this state has finished and the bytes are host-side. This is the
+        # whole synchronous cost of an async save.
+        snapshot = jax.device_get(state)
+        step = int(snapshot.step)
+        path = step_dir(checkpoint_dir, step)
+
+        def _commit() -> None:
+            try:
+                _commit_checkpoint(
+                    checkpoint_dir, path, snapshot,
+                    step=step, model_config=model_config,
+                    training_config=training_config, tokens_seen=tokens_seen,
+                    data_state=data_state, keep_last_n=keep_last_n,
+                    use_async_writer=True,
+                )
+            except BaseException as e:  # surfaced by the next wait()
+                self._error = e
+
+        self._path = path
+        self._thread = threading.Thread(
+            target=_commit, name=f"ckpt-commit-{step}", daemon=True
+        )
+        self._thread.start()
+        return path
 
 
 def _corrupt_some_shard(path: str) -> None:

@@ -27,6 +27,27 @@ except ImportError:  # old API: experimental, check_rep, auto
 # fallback should consult this flag and take the fallback on old jax.
 PARTIAL_MANUAL_OK = _NEW
 
+# Async checkpoint writes (utils/checkpoint.py AsyncSaver) prefer orbax's
+# AsyncCheckpointer for the background shard write when the installed orbax
+# exposes it; otherwise the writer thread falls back to the synchronous
+# StandardCheckpointer. Either way the train loop only pays the host
+# snapshot — this gate selects the writer implementation, not the overlap.
+# TPU_TRAINER_NO_ORBAX_ASYNC=1 forces the fallback (used by tests to cover
+# both writers on one orbax version).
+import os as _os
+
+try:
+    import orbax.checkpoint as _ocp
+
+    ORBAX_ASYNC_OK = (
+        hasattr(_ocp, "AsyncCheckpointer")
+        and hasattr(_ocp, "StandardCheckpointHandler")
+        and hasattr(_ocp.args, "StandardSave")
+        and not _os.environ.get("TPU_TRAINER_NO_ORBAX_ASYNC")
+    )
+except ImportError:  # orbax absent entirely (inference-only installs)
+    ORBAX_ASYNC_OK = False
+
 # The blockwise fused head+CE (ops/loss.py fused_shifted_cross_entropy)
 # produces NaN under sequence-sharded activations when the mesh composes
 # sequence x tensor axes on the old API generation. Localized by --nan_scan

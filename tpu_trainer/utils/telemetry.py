@@ -37,6 +37,7 @@ Three pieces, one module (ISSUE 2):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import math
 import statistics
@@ -303,6 +304,12 @@ class GoodputLedger:
         "step",
         "eval",
         "checkpoint_save",
+        # Draining an in-flight async commit (utils/checkpoint.py
+        # AsyncSaver.wait) before the next save/rollback/exit. With async
+        # checkpointing on, "checkpoint_save" shrinks to the host-snapshot
+        # cost and any residual commit time the run actually waited for
+        # shows up here instead of inflating the save number.
+        "checkpoint_commit_wait",
         "checkpoint_restore",
         "rollback_replay",
     )
@@ -357,11 +364,11 @@ class GoodputLedger:
         for cat in self.CATEGORIES:
             if f"{cat}_seconds" in rec:
                 lines.append(
-                    f"  {cat:<19} {rec[f'{cat}_seconds']:9.2f}s "
+                    f"  {cat:<22} {rec[f'{cat}_seconds']:9.2f}s "
                     f"{rec[f'{cat}_frac']:6.1%}"
                 )
         lines.append(
-            f"  {'untracked':<19} "
+            f"  {'untracked':<22} "
             f"{rec['untracked_frac'] * rec['total_seconds']:9.2f}s "
             f"{rec['untracked_frac']:6.1%}"
         )
@@ -415,3 +422,62 @@ class SpikeDetector:
             self._hist.pop(0)
         self._hist.append(loss)
         return False, z
+
+
+# --- deferred host sync ------------------------------------------------------
+
+
+class DeferredFetcher:
+    """Bounded window of in-flight per-step metric futures.
+
+    jax dispatch is async: ``train_step`` returns device arrays that are
+    still being computed, and the first ``float(loss)`` is where the host
+    actually blocks. Reading step N's loss right after dispatching step N
+    serializes host and device. Instead the CLI ``push()``es each step's
+    metrics here and only materializes entries once they are ``window``
+    steps old — by which time the device has long finished them, so the
+    ``jax.device_get`` returns ~immediately and the host stays ahead of
+    the device instead of in lockstep with it.
+
+    Consequences the consumers accept: the spike detector, MetricLogger,
+    and NaN guards see step N's numbers ``window`` steps late, so a
+    divergence is detected up to ``window`` steps after it happened —
+    harmless, because recovery rolls back to a checkpoint that predates
+    the spike by far more than ``window`` steps anyway.
+
+    ``push()`` returns the entries that matured this step (oldest first);
+    ``drain()`` materializes everything (eval/save/rollback/exit
+    boundaries, where the state sync already paid the wait). ``transform``
+    is applied to the fetched host copy at maturity — fault injections
+    that mutate a loss must compose with the lagged value, not the live
+    device array. ``window=0`` degrades to the old synchronous behavior.
+    """
+
+    def __init__(self, window: int = 2):
+        self.window = max(0, int(window))
+        self._q: collections.deque = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def push(self, step: int, metrics: dict,
+             transform=None) -> List[Tuple[int, dict]]:
+        self._q.append((step, metrics, transform))
+        out = []
+        while len(self._q) > self.window:
+            out.append(self._fetch(self._q.popleft()))
+        return out
+
+    def drain(self) -> List[Tuple[int, dict]]:
+        out = []
+        while self._q:
+            out.append(self._fetch(self._q.popleft()))
+        return out
+
+    @staticmethod
+    def _fetch(entry) -> Tuple[int, dict]:
+        step, metrics, transform = entry
+        host = jax.device_get(metrics)
+        if transform is not None:
+            host = transform(host)
+        return step, host
