@@ -55,6 +55,10 @@ def _build_parser():
     p.add_argument("--steps", type=int, default=int(env("BENCH_STEPS", "60")))
     p.add_argument("--accum", type=int, default=int(env("BENCH_ACCUM", "1")))
     p.add_argument("--flash", type=int, default=int(env("BENCH_FLASH", "1")))
+    p.add_argument("--flash-bwd", default=env("BENCH_FLASH_BWD", "auto"),
+                   choices=("auto", "fused", "split"),
+                   help="flash backward kernel dispatch override "
+                        "(auto: fused <= 2048, split beyond)")
     p.add_argument("--remat", type=int, default=None,
                    help="default: on for medium/large/xl")
     p.add_argument("--mesh-data", type=int, default=None)
@@ -563,18 +567,13 @@ def main() -> None:
 
         jax.config.update("jax_platforms", plat)
     args = _build_parser().parse_args()
-    if args.seq_len > 2048 and "scoped_vmem" not in os.environ.get(
-            "LIBTPU_INIT_ARGS", ""):
-        # The flash backward keeps full-sequence q/do/dq row blocks in
-        # VMEM (grid walks key blocks); past s=2048 that overflows the
-        # compiler's default 16 MB scoped-VMEM budget. v5e has 128 MB of
-        # physical VMEM — raise the scope before libtpu loads (measured:
-        # unlocks s=4096/8192; see benchmarks/results.md sequence
-        # scaling).
-        os.environ["LIBTPU_INIT_ARGS"] = (
-            os.environ.get("LIBTPU_INIT_ARGS", "")
-            + " --xla_tpu_scoped_vmem_limit_kib=49152"
-        ).strip()
+    # No LIBTPU_INIT_ARGS scoped-VMEM raise here anymore: the flash
+    # backward now dispatches to the two-kernel split path past s=2048
+    # (s-independent VMEM residency, see ops/flash.py), so every sequence
+    # length runs at default compiler flags. --flash_bwd forces a path for
+    # A/B sweeps.
+    if args.flash_bwd != "auto":
+        os.environ["TPU_TRAINER_FLASH_BWD"] = args.flash_bwd
     if args.validate:
         from tpu_trainer.validate import main as validate_main
 

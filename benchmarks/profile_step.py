@@ -115,6 +115,10 @@ def _hlo_stats(xplane_path: str):
     return json.loads(data)
 
 
+# Fallback classifier for converter builds whose hlo_stats omits the
+# "HLO op category" column (or leaves it blank): first pattern matching
+# the op name or HLO text wins. When the converter does emit categories,
+# its (more precise) labels are used as-is and this table is bypassed.
 _CATS = [
     ("flash kernel", re.compile(r"flash|custom-call.*pallas|attn", re.I)),
     ("head_ce kernel", re.compile(r"head_ce|_head_ce_fwd", re.I)),
@@ -122,6 +126,13 @@ _CATS = [
     ("copy/convert", re.compile(r"copy|convert|transpose|bitcast", re.I)),
     ("elementwise", re.compile(r"fusion|add|multiply|select", re.I)),
 ]
+
+
+def _fallback_category(name: str, expr: str) -> str:
+    for label, pat in _CATS:
+        if pat.search(name) or pat.search(expr):
+            return label
+    return "other"
 
 
 def main() -> None:
@@ -164,7 +175,9 @@ def main() -> None:
     for vals in rows:
         name = str(col(vals, "HLO op name", default=""))
         expr = str(col(vals, "HLO op text", default=""))
-        cat = str(col(vals, "HLO op category", default=""))
+        cat = str(col(vals, "HLO op category", default="") or "").strip()
+        if not cat or cat.lower() == "none":
+            cat = _fallback_category(name, expr)
         us = float(col(vals, "Total self time (us)", default=0) or 0)
         occ = int(col(vals, "#Occurrences", default=0) or 0)
         key = re.sub(r"\.\d+$", "", name)

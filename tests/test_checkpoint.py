@@ -104,6 +104,50 @@ class TestCheckpoint:
                                        t_ddp.put_batch(batches(1, t_ddp)[0]))
         assert np.isfinite(float(m["loss"]))
 
+    def test_restore_bf16_moments_across_strategy_change(self, tmp_path):
+        # Cross-strategy resume with NARROW optimizer state: bf16 moments
+        # (optimizer_state_dtype=bfloat16) saved under ZeRO-3 — sharded
+        # ScaleByAdamQState leaves — restored onto a replicated mesh. The
+        # opt-state tree differs from the f32 default (large leaves are
+        # bf16), so this pins that the eval_shape-derived restore targets
+        # and the resharding both follow the narrow tree. Model is sized
+        # so the embedding crosses _QUANT_MIN_SIZE (512 x 128 = 64k) and
+        # moments actually narrow.
+        import jax.numpy as jnp
+
+        model = dataclasses.replace(MODEL, vocab_size=512, hidden_size=128)
+        tc = dataclasses.replace(TRAIN, optimizer_state_dtype="bfloat16")
+
+        t_z3 = Trainer(model, tc,
+                       ParallelConfig(MeshConfig(data=1, fsdp=8), "zero3"),
+                       mesh=make_mesh(MeshConfig(data=1, fsdp=8)))
+        s = t_z3.init_state()
+        for b in batches(2, t_z3):
+            s, _ = t_z3.train_step(s, t_z3.put_batch(b))
+        path = ckpt.save_checkpoint(str(tmp_path), s, model_config=model,
+                                    training_config=tc)
+
+        t_rep = Trainer(model, tc,
+                        ParallelConfig(MeshConfig(data=8, fsdp=1),
+                                       "replicated"),
+                        mesh=make_mesh(MeshConfig(data=8, fsdp=1)))
+        restored, _ = ckpt.restore_checkpoint(path, t_rep)
+        opt_dtypes = {
+            x.dtype for x in jax.tree_util.tree_leaves(restored.opt_state)
+            if getattr(x, "ndim", 0) >= 2
+        }
+        assert jnp.dtype("bfloat16") in opt_dtypes  # moments really narrow
+        for leaf in jax.tree_util.tree_leaves(
+            (restored.params, restored.opt_state)
+        ):
+            assert leaf.sharding.is_fully_replicated
+        assert_tree_equal(s.params, restored.params, rtol=0, atol=0)
+        assert_tree_equal(s.opt_state, restored.opt_state, rtol=0, atol=0)
+        # and it trains on under the new strategy.
+        restored, m = t_rep.train_step(restored,
+                                       t_rep.put_batch(batches(1, t_rep)[0]))
+        assert np.isfinite(float(m["loss"]))
+
     def test_latest_checkpoint_selection(self, tmp_path):
         trainer = make_trainer()
         state = trainer.init_state()

@@ -267,6 +267,130 @@ class TestFusedRope:
         np.testing.assert_allclose(got, expected, atol=2e-5, rtol=2e-5)
 
 
+class TestSplitBackwardParity:
+    """Two-kernel (split) backward vs the fused single-pass kernel.
+
+    The split path (dkv kernel gridded over key blocks + dq kernel gridded
+    over query blocks, s-independent VMEM — ops/flash.py) recomputes the
+    score/probability chain per kernel from the same residuals, lse/delta
+    rows, and absolute-coordinate dropout counters, so its dq/dk/dv must
+    agree with the fused kernel at f32-accumulation tolerances. With
+    dropout on, any mask-regeneration divergence between the two kernels
+    would produce O(1) gradient errors, so the tight tolerance doubles as
+    the bit-exact mask check.
+    """
+
+    def _grads(self, backward, s, h=2, kvh=None, d=32, dropout=0.0,
+               rope=False, block=512):
+        kvh = h if kvh is None else kvh
+        key = jax.random.PRNGKey(42)
+        kq, kk, kv, kd = jax.random.split(key, 4)
+        q = jax.random.normal(kq, (1, s, h, d), jnp.float32)
+        k = jax.random.normal(kk, (1, s, kvh, d), jnp.float32)
+        v = jax.random.normal(kv, (1, s, kvh, d), jnp.float32)
+        rope_t = None
+        if rope:
+            from tpu_trainer.ops.rope import rope_tables
+
+            rope_t = rope_tables(s, d)
+        probe = jax.random.normal(jax.random.PRNGKey(43), q.shape)
+
+        def loss(q, k, v):
+            out = flash_attention(
+                q, k, v, interpret=True, block_q=block, block_k=block,
+                dropout_rate=dropout,
+                dropout_rng=kd if dropout > 0.0 else None,
+                rope=rope_t, backward=backward,
+            )
+            return jnp.sum(out * probe)
+
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    def _assert_parity(self, s, **kw):
+        g_fused = self._grads("fused", s, **kw)
+        g_split = self._grads("split", s, **kw)
+        for got, expected, name in zip(g_split, g_fused, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(expected), atol=1e-6, rtol=1e-6,
+                err_msg=f"d{name} split-vs-fused (s={s}, {kw})",
+            )
+
+    @pytest.mark.parametrize("s", [1024, 2048, 4096])
+    def test_parity_across_seq(self, s):
+        self._assert_parity(s)
+
+    @pytest.mark.parametrize("s", [1024, 2048, 4096])
+    def test_parity_dropout_on(self, s):
+        # Dropout masks regenerate from absolute (q, k) coordinates in
+        # both split kernels; a single flipped keep bit is an O(1) error.
+        self._assert_parity(s, dropout=0.2)
+
+    def test_parity_gqa(self):
+        # hp == 1 interpret path: K/V via the ip // group index map in
+        # both split kernels, f32 per-query-head dk/dv partials group-
+        # summed by the caller.
+        self._assert_parity(1024, h=4, kvh=2, dropout=0.1)
+
+    def test_parity_fused_rope(self):
+        # Rotated residuals: the dkv kernel un-rotates dk with K-row
+        # cos/sin blocks, the dq kernel un-rotates dq with Q-row blocks.
+        self._assert_parity(1024, rope=True)
+
+    def test_parity_asymmetric_blocks(self):
+        g_fused = self._grads("fused", 2048, block=512)
+        # Split path at a different (still 512-divisible) block shape:
+        # dropout-free here, so block shape must not change the math.
+        key = jax.random.PRNGKey(42)
+        kq, kk, kv, _ = jax.random.split(key, 4)
+        q = jax.random.normal(kq, (1, 2048, 2, 32), jnp.float32)
+        k = jax.random.normal(kk, (1, 2048, 2, 32), jnp.float32)
+        v = jax.random.normal(kv, (1, 2048, 2, 32), jnp.float32)
+        probe = jax.random.normal(jax.random.PRNGKey(43), q.shape)
+
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, interpret=True, block_q=1024,
+                                  block_k=512, backward="split")
+            return jnp.sum(out * probe)
+
+        g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        for got, expected, name in zip(g_split, g_fused, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(expected), atol=1e-5, rtol=1e-5,
+                err_msg=f"d{name} block-shape invariance",
+            )
+
+    def test_auto_dispatch_defaults(self):
+        # s <= 2048 must keep the fused kernel BIT-identically (the
+        # headline-row no-regression contract); past the threshold auto
+        # selects split. backward=None vs the forced path must therefore
+        # be exact array_equal, not just allclose.
+        for s, expect in ((1024, "fused"), (4096, "split")):
+            g_auto = self._grads(None, s)
+            g_forced = self._grads(expect, s)
+            for got, expected, name in zip(g_auto, g_forced, "qkv"):
+                np.testing.assert_array_equal(
+                    np.asarray(got), np.asarray(expected),
+                    err_msg=f"d{name} auto != {expect} at s={s}",
+                )
+
+    def test_env_knob_overrides_auto(self, monkeypatch):
+        from tpu_trainer.ops import flash as flash_mod
+
+        monkeypatch.setenv("TPU_TRAINER_FLASH_BWD", "split")
+        g_env = self._grads(None, 1024)
+        monkeypatch.delenv("TPU_TRAINER_FLASH_BWD")
+        g_split = self._grads("split", 1024)
+        for got, expected in zip(g_env, g_split):
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(expected))
+        assert flash_mod._FUSED_BWD_MAX_SEQ == 2048
+
+    def test_bad_backward_rejected(self):
+        q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 128, 1, 16)
+        with pytest.raises(ValueError, match="backward"):
+            flash_attention(q, k, v, interpret=True, backward="bogus")
+
+
 def test_causal_masking_is_exact():
     # Token t's output must not change when future tokens change.
     b, s, h, d = 1, 256, 1, 64

@@ -167,6 +167,27 @@ class TestOffloadCastHelpers:
                         jax.tree_util.tree_leaves(back)):
             assert a.dtype == b.dtype
 
+    def test_partial_offload_budget_is_per_device_under_fsdp(self):
+        # Under zero2/zero3 the moments are fsdp-sharded: a kept leaf
+        # costs size/shard_count per-device bytes, so the same budget
+        # keeps shard_count-times more moments than the global-bytes
+        # accounting would (leaves with no fsdp-divisible dim stay
+        # replicated and cost full size).
+        from tpu_trainer.training.trainer import select_resident_moments
+
+        shapes = {
+            "mu": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+            "nu": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+            "bias": jax.ShapeDtypeStruct((30,), jnp.float32),
+        }
+        big = 64 * 32 * 4
+        keep, used = select_resident_moments(shapes, big)
+        assert len(keep) == 1 and used == big
+        keep8, used8 = select_resident_moments(shapes, big, shard_count=8)
+        assert keep8 == frozenset({("mu",), ("nu",), ("bias",)})
+        # (64, 32) shards 8-ways; the 30-vector has no dim divisible by 8.
+        assert used8 == 2 * (big // 8) + 30 * 4
+
     def test_noop_without_cast(self):
         t = self._trainer()
         assert t._offload_cast is None

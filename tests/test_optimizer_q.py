@@ -8,7 +8,9 @@ Pins three contracts of ``scale_by_adam_quantized``:
   tolerance over tens of steps (the 8-bit-optimizer claim, tested the way
   the int8 offload state is — tests/test_offload.py);
 - the state roundtrips through the checkpoint path (the packed moments
-  are a plain dict-of-arrays pytree).
+  are ``QuantPack`` pytree nodes that flatten to plain arrays);
+- packs are identified by TYPE: a params subtree that happens to use the
+  keys {"q", "scale"} is never mistaken for a quantized moment.
 
 No reference counterpart: the reference has fp32 torch.optim.AdamW only
 (``ddp_trainer.py:174-234``).
@@ -103,6 +105,38 @@ class TestScaleByAdamQuantized:
     def test_bad_dtype_rejected(self):
         with pytest.raises(ValueError, match="optimizer_state_dtype"):
             make_optimizer(TrainingConfig(optimizer_state_dtype="int16"))
+
+    def test_params_named_q_scale_are_not_mistaken_for_packs(self):
+        # Regression: the old is_pack heuristic keyed on dict KEYS
+        # ({"q", "scale"}), so a params subtree with those names flattened
+        # as one pack leaf and silently misaligned grads with moments.
+        # QuantPack is a registered pytree node now — identification is by
+        # type, and this attention-like tree must update bitwise like
+        # optax (all leaves below the quantization threshold stay f32).
+        import optax
+
+        from tpu_trainer.utils.quant import QuantPack
+
+        key = jax.random.PRNGKey(2)
+        params = {"attn": {"q": jax.random.normal(key, (16, 16)),
+                           "scale": jnp.ones((16,))},
+                  "out": jax.random.normal(key, (16, 8))}
+        tx_q = scale_by_adam_quantized(0.9, 0.95, 1e-8, "int8")
+        tx_f = optax.scale_by_adam(b1=0.9, b2=0.95, eps=1e-8)
+        sq, sf = tx_q.init(params), tx_f.init(params)
+        assert not any(
+            isinstance(x, QuantPack)
+            for x in jax.tree_util.tree_leaves(
+                sq.mu, is_leaf=lambda x: isinstance(x, QuantPack))
+        )
+        for i in range(3):
+            g = _tree_map(lambda p: jax.random.normal(
+                jax.random.fold_in(key, i), p.shape), params)
+            uq, sq = tx_q.update(g, sq, params)
+            uf, sf = tx_f.update(g, sf, params)
+            for a, b in zip(jax.tree_util.tree_leaves(uq),
+                            jax.tree_util.tree_leaves(uf)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 class TestTrainerIntegration:

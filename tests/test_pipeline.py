@@ -622,7 +622,7 @@ class TestScheduleDropoutEquivalence(_StrategyHarness):
     ``make_rng``, so the schedules are not bitwise-comparable with dropout
     enabled. What MUST still hold: training curves agree within dropout
     noise. Tolerance is calibrated in-test from GPipe's own seed-to-seed
-    spread (two init seeds), not hand-tuned."""
+    spread (three init seeds), not hand-tuned."""
 
     def test_dropout_on_curves_agree_within_noise(self):
         import dataclasses as dc
@@ -654,23 +654,29 @@ class TestScheduleDropoutEquivalence(_StrategyHarness):
                 curve.append(float(m["loss"]))
             return np.array(curve)
 
-        gpipe0 = run("gpipe", 0)
-        gpipe1 = run("gpipe", 1)
+        gpipe_runs = [run("gpipe", seed) for seed in (0, 1, 2)]
         ofob = run("1f1b", 0)
         il = run("interleaved", 0)
 
-        for c in (gpipe0, gpipe1, ofob, il):
+        for c in (*gpipe_runs, ofob, il):
             assert np.all(np.isfinite(c))
             assert c[-tail:].mean() < c[0]  # every schedule trains
 
-        # Noise scale: GPipe's own spread across init seeds (different
-        # params AND dropout stream), floored to avoid a degenerate band.
-        noise = max(abs(gpipe0[-tail:].mean() - gpipe1[-tail:].mean()),
-                    0.02 * gpipe0[-tail:].mean())
+        # Noise scale: GPipe's own spread across >=3 init seeds (different
+        # params AND dropout streams) — max pairwise tail-mean gap, floored
+        # to avoid a degenerate band when the seeds happen to land close.
+        tails = [c[-tail:].mean() for c in gpipe_runs]
+        spread = max(tails) - min(tails)
+        noise = max(spread, 0.02 * tails[0])
         for name, c in (("1f1b", ofob), ("interleaved", il)):
-            delta = abs(c[-tail:].mean() - gpipe0[-tail:].mean())
+            delta = abs(c[-tail:].mean() - tails[0])
             assert delta < 3.0 * noise, (
-                name, delta, noise, c[-tail:].mean(), gpipe0[-tail:].mean()
+                f"{name}: tail-mean {c[-tail:].mean():.4f} deviates from "
+                f"gpipe seed-0 {tails[0]:.4f} by {delta:.4f}, exceeding "
+                f"3x the noise band {noise:.4f}; band calibrated from "
+                f"gpipe tail means over seeds (0, 1, 2) = "
+                f"{[round(float(t), 4) for t in tails]} "
+                f"(seed spread {spread:.4f}, floor 2% of seed-0 tail)"
             )
 
 

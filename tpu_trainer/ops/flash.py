@@ -22,12 +22,21 @@ benchmarks/results.md "Round-3 kernel push"):
   single-block fast path drops the online softmax entirely.
 - Operands are the model's FOLDED ``[b, s, h*d]`` layout, sliced per
   head(-pair) by the BlockSpecs: no BSHD transpose ever exists in HBM.
-- Backward is one fused kernel (grid over key blocks) with its own block
-  shape (512x512: it is FLOP-bound, causal skipping wins): one
-  score/probability evaluation per block pair feeds dk, dv, and dq — dq
-  accumulates in f32 in a VMEM-resident full-row block across sequential
-  grid steps — using the saved per-row logsumexp and the precomputed
-  ``delta = rowsum(dO * O)``.
+- Backward DISPATCHES on sequence length. At s <= 2048 it is one fused
+  kernel (grid over key blocks) with its own block shape (512x512: it is
+  FLOP-bound, causal skipping wins): one score/probability evaluation per
+  block pair feeds dk, dv, and dq — dq accumulates in f32 in a
+  VMEM-resident full-row block across sequential grid steps — using the
+  saved per-row logsumexp and the precomputed ``delta = rowsum(dO * O)``.
+  That full-row residency grows with s and overflows Mosaic's 16 MB
+  default scope past s=2048, so longer sequences take the SPLIT
+  two-kernel backward (the FlashAttention-2 structure): a dkv kernel
+  gridded over key blocks (dk/dv accumulate in VMEM scratch while q/do
+  blocks stream through an extra grid dimension) and a dq kernel gridded
+  over query blocks (dq accumulates while k/v blocks stream). Nothing
+  resident scales with s, at the cost of a second score evaluation
+  (7 dots per block pair vs 5). ``backward="fused"|"split"|"auto"`` /
+  ``TPU_TRAINER_FLASH_BWD`` override the dispatch.
 - Attention-weight dropout runs in-kernel from the core's hardware PRNG
   (compiled) or a counter-based hash (interpret), generated in fixed
   512x512 tiles keyed by absolute position so the backward regenerates
@@ -48,6 +57,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -249,11 +259,16 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
     iq = pl.program_id(2)
     q_start = iq * block_q
     seed = _seed_from_ref(seed_ref)
+    # Hoisted out of the (pl.when-predicated) block bodies: program_id
+    # staged inside a predicated body lowers as a plain cond branch in
+    # interpret mode, where the primitive has no rule outside the grid
+    # interpreter context.
+    salt0 = _block_salt()
 
     def head_salt(t):
         # Unique per (batch, global head); equals _block_salt at hp == 1,
         # keeping the interpret-mode hash stream bit-stable with round 2.
-        return _block_salt() * jnp.uint32(hp) + jnp.uint32(t)
+        return salt0 * jnp.uint32(hp) + jnp.uint32(t)
 
     # Inputs stay in their storage dtype (bf16 in training): the MXU runs
     # bf16 x bf16 -> f32 at full rate, while f32 x f32 matmuls cost ~8x.
@@ -510,9 +525,10 @@ def _bwd_fused_kernel(
     # dq accumulation across programs, no scratch round-trips, and the
     # dropout seed position is the same static (0, 0) the forward used.
     single = num_q == 1 and seq == block_k
+    salt0 = _block_salt()  # hoisted out of the pl.when bodies (see _fwd_kernel)
 
     def head_salt(t):
-        return _block_salt() * jnp.uint32(hp) + jnp.uint32(t)
+        return salt0 * jnp.uint32(hp) + jnp.uint32(t)
 
     # Under fuse_rope the forward already wrote rotated k and
     # rotated-scaled q as outputs (see _fwd_kernel): they arrive here as
@@ -648,10 +664,216 @@ def _bwd_fused_kernel(
                 ).astype(dq_ref.dtype)
 
 
+# The fused kernel keeps full-sequence q/do/dq row blocks VMEM-resident,
+# so its footprint grows with s: measured on v5e it fits Mosaic's 16 MB
+# default scope through s=2048 and overflows at s=4096 (the old escape
+# hatch was raising --xla_tpu_scoped_vmem_limit_kib, which steals scope
+# from every other kernel in the step). Past this threshold the dispatch
+# selects the two-kernel split backward, whose residency is per-block
+# only (s-independent). Below it the fused kernel wins: one score
+# evaluation feeds dk, dv, AND dq (the split path recomputes scores in
+# each kernel — 7 dots per block pair vs 5).
+_FUSED_BWD_MAX_SEQ = 2048
+
+
+def _bwd_dkv_kernel(
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale, causal, dropout_rate, fuse_rope, hw_prng, hp, seq,
+):
+    """dk/dv half of the two-kernel (split) backward.
+
+    Grid ``(b, h/hp, seq // block_k, seq // block_q)``: each program owns
+    one K/V block (its index map is constant in the innermost grid
+    dimension, so dk/dv accumulate in VMEM scratch across the sequential
+    q-block walk) and sees one q/do block per grid step. Nothing resident
+    scales with the sequence length — q/do arrive blocked through the
+    grid, lse/delta arrive as per-q-block rows, and under ``fuse_rope``
+    cos/sin arrive as the K-rows block (only the final dk un-rotation
+    needs them; the residual q/k are pre-rotated). Causal below-diagonal
+    blocks (q entirely before k) are skipped by ``pl.when`` predication,
+    exactly as in the fused kernel.
+
+    The per-(q,k) block math is the fused kernel's ``body`` verbatim minus
+    the dq contribution, and the dropout mask comes from the same
+    absolute-coordinate counter hash / PRNG tiles (``_keep``), so masks
+    regenerate bit-for-bit across the forward and both split kernels.
+    """
+    if fuse_rope:
+        cos_ref, sin_ref, dk_ref, dv_ref, *scrs = rest
+    else:
+        dk_ref, dv_ref, *scrs = rest
+    dk_scrs, dv_scrs = scrs[:hp], scrs[hp:]
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2] // hp
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    k_start = ik * block_k
+    q_start = iq * block_q
+    seed = _seed_from_ref(seed_ref)
+    salt0 = _block_salt()  # hoisted out of the pl.when bodies (see _fwd_kernel)
+
+    def head_salt(t):
+        return salt0 * jnp.uint32(hp) + jnp.uint32(t)
+
+    @pl.when(iq == 0)
+    def _zero():
+        for t in range(hp):
+            dk_scrs[t][...] = jnp.zeros((block_k, d), jnp.float32)
+            dv_scrs[t][...] = jnp.zeros((block_k, d), jnp.float32)
+
+    def body(masked: bool):
+        for t in range(hp):
+            k = k_ref[0, :, pl.ds(t * d, d)]
+            v = v_ref[0, :, pl.ds(t * d, d)]
+            q = q_ref[0, :, pl.ds(t * d, d)]
+            do = do_ref[0, :, pl.ds(t * d, d)]
+            if not fuse_rope:
+                # fuse_rope residuals arrive pre-scaled (see _fwd_kernel).
+                q = (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
+            lse = lse_ref[0, t, 0, :][:, None]
+            delta = delta_ref[0, t, 0, :][:, None]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk] (scaled via q)
+            if masked:
+                diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                        - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+                s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                keep = _keep(seed, head_salt(t), q_start, k_start,
+                             block_q, block_k, seq, dropout_rate, hw_prng)
+                p_drop = jnp.where(keep, p, 0.0)
+                dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+            else:
+                p_drop = p
+            dv_scrs[t][...] += jax.lax.dot_general(
+                p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            dk_scrs[t][...] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    if not causal:
+        body(False)
+    else:
+        needed = q_start + block_q - 1 >= k_start
+        full = q_start >= k_start + block_k - 1
+        pl.when(full)(functools.partial(body, False))
+        pl.when(needed & jnp.logical_not(full))(functools.partial(body, True))
+
+    @pl.when(iq == pl.num_programs(3) - 1)
+    def _flush():
+        for t in range(hp):
+            dk = dk_scrs[t][...]
+            dv = dv_scrs[t][...]
+            if fuse_rope:
+                dk = _unrotate_grad(dk, cos_ref[...], sin_ref[...])
+            if dropout_rate > 0.0:
+                dv = dv / (1.0 - dropout_rate)
+            dk_ref[0, :, pl.ds(t * d, d)] = dk.astype(dk_ref.dtype)
+            dv_ref[0, :, pl.ds(t * d, d)] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    scale, causal, dropout_rate, fuse_rope, hw_prng, hp, seq,
+):
+    """dq half of the two-kernel (split) backward.
+
+    Grid ``(b, h/hp, seq // block_q, seq // block_k)``: each program owns
+    one q/do/dq block (dq accumulates in VMEM scratch across the
+    sequential k-block walk; its output index map is constant in the
+    innermost grid dimension) and sees one K/V block per grid step.
+    Residency is per-block only — see ``_bwd_dkv_kernel``. Under
+    ``fuse_rope`` cos/sin arrive as the Q-rows block for the final dq
+    un-rotation. ``ds`` recomputes from the same ``p``/``dp``/dropout
+    chain as the dkv kernel so both halves see identical score gradients.
+    """
+    if fuse_rope:
+        cos_ref, sin_ref, dq_ref, *dq_scrs = rest
+    else:
+        dq_ref, *dq_scrs = rest
+    block_q = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+    d = q_ref.shape[2] // hp
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    q_start = iq * block_q
+    k_start = ik * block_k
+    seed = _seed_from_ref(seed_ref)
+    salt0 = _block_salt()  # hoisted out of the pl.when bodies (see _fwd_kernel)
+
+    def head_salt(t):
+        return salt0 * jnp.uint32(hp) + jnp.uint32(t)
+
+    @pl.when(ik == 0)
+    def _zero():
+        for t in range(hp):
+            dq_scrs[t][...] = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(masked: bool):
+        for t in range(hp):
+            q = q_ref[0, :, pl.ds(t * d, d)]
+            do = do_ref[0, :, pl.ds(t * d, d)]
+            k = k_ref[0, :, pl.ds(t * d, d)]
+            v = v_ref[0, :, pl.ds(t * d, d)]
+            if not fuse_rope:
+                q = (q.astype(jnp.float32) * scale).astype(q_ref.dtype)
+            lse = lse_ref[0, t, 0, :][:, None]
+            delta = delta_ref[0, t, 0, :][:, None]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if masked:
+                diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                        - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+                s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if dropout_rate > 0.0:
+                keep = _keep(seed, head_salt(t), q_start, k_start,
+                             block_q, block_k, seq, dropout_rate, hw_prng)
+                dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+            ds = p * (dp - delta)
+            dq_scrs[t][...] += jnp.dot(
+                ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+            ) * scale
+
+    if not causal:
+        body(False)
+    else:
+        needed = q_start + block_q - 1 >= k_start
+        full = q_start >= k_start + block_k - 1
+        pl.when(full)(functools.partial(body, False))
+        pl.when(needed & jnp.logical_not(full))(functools.partial(body, True))
+
+    @pl.when(ik == pl.num_programs(3) - 1)
+    def _flush():
+        for t in range(hp):
+            dq = dq_scrs[t][...]
+            if fuse_rope:
+                dq = _unrotate_grad(dq, cos_ref[...], sin_ref[...])
+            dq_ref[0, :, pl.ds(t * d, d)] = dq.astype(dq_ref.dtype)
+
+
 def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
                     head_dim, num_kv_heads, causal, block_q, block_k,
                     interpret, dropout_rate, dlse=None,
-                    f32_kv_grads=False):
+                    f32_kv_grads=False, backward=None):
     # Folded operands throughout (see _flash_forward). The backward runs
     # its own block sizes: measured on v5e the backward is MXU/FLOP-bound
     # (5 dots per block, no online-softmax rescan), so causal block
@@ -693,10 +915,6 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
     fuse_rope = rope is not None
     rope_args = tuple(rope) if fuse_rope else ()
 
-    # Fused single pass; dq accumulates in f32 across kv-block grid steps
-    # (its block index is constant in that dimension, so it stays in VMEM).
-    # Under fused rope, dq and dk are un-rotated *inside* the kernel (VMEM)
-    # before they are written — no external pass over the gradients.
     # Under GQA (hp == 1 path) each query head writes per-head dk/dv
     # partials ([b, s, h*d], the same size MHA's dk/dv would be). The
     # partials leave the kernel in f32 so the caller's group-sum
@@ -705,6 +923,96 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
     # [b, s, h*d] f32 footprint is the same one the MHA dq already pays).
     kv_grad_dtype = (jnp.float32 if group > 1 or f32_kv_grads
                      else k3.dtype)
+
+    # Backward dispatch: the fused single pass wins while its full-row
+    # q/do/dq residency is cheap (s <= _FUSED_BWD_MAX_SEQ — one score
+    # evaluation feeds dk, dv, and dq); past that it would overflow the
+    # 16 MB default scope, so the split two-kernel path (s-independent
+    # VMEM) takes over. ``backward`` in {"fused", "split"} overrides for
+    # the sweep (benchmarks/longseq_block_sweep.py) and the parity tests.
+    impl = backward or ("fused" if s <= _FUSED_BWD_MAX_SEQ else "split")
+    if impl == "fused":
+        # The fused pass takes its preferred 512 blocks (FLOP-bound, 5
+        # dots per block pair; causal block-skipping computes 3/4 of the
+        # score square, and the paired program's f32 [bq, bk] working set
+        # stays inside the 16 MB scope — single 1024x1024 blocks blow
+        # it). The split kernels keep the caller's blocks: their
+        # residency is s-independent, so larger blocks just mean fewer
+        # grid steps.
+        if block_q % _BWD_BLOCK == 0:
+            block_q = _BWD_BLOCK
+        if block_k % _BWD_BLOCK == 0:
+            block_k = _BWD_BLOCK
+    if impl == "split":
+        kernel_kw = dict(scale=scale, causal=causal,
+                         dropout_rate=dropout_rate, fuse_rope=fuse_rope,
+                         hw_prng=not interpret, hp=hp, seq=s)
+        gqa_map = not (hp > 1 or group == 1)
+        # dkv pass: grid (b, h/hp, k blocks, q blocks) — dk/dv block
+        # indices are constant in the innermost (q) dimension, so they
+        # stay VMEM-resident accumulating across the q walk.
+        q_blk = pl.BlockSpec((1, block_q, hp * d),
+                             lambda ib, ip, ik, iq: (ib, iq, ip))
+        kv_in = pl.BlockSpec(
+            (1, block_k, hp * d),
+            (lambda ib, ip, ik, iq: (ib, ik, ip // group)) if gqa_map
+            else (lambda ib, ip, ik, iq: (ib, ik, ip)),
+        )
+        kv_out = pl.BlockSpec((1, block_k, hp * d),
+                              lambda ib, ip, ik, iq: (ib, ik, ip))
+        row_q = pl.BlockSpec((1, hp, 1, block_q),
+                             lambda ib, ip, ik, iq: (ib, ip, 0, iq))
+        rope_k = [pl.BlockSpec((block_k, d),
+                               lambda ib, ip, ik, iq: (ik, 0))] * 2
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, **kernel_kw),
+            grid=(b, h // hp, s // block_k, s // block_q),
+            in_specs=[_seed_spec(), q_blk, kv_in, kv_in, q_blk, row_q,
+                      row_q] + (rope_k if fuse_rope else []),
+            out_specs=[kv_out, kv_out],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, s, h * d), kv_grad_dtype),
+                jax.ShapeDtypeStruct((b, s, h * d), kv_grad_dtype),
+            ],
+            scratch_shapes=(
+                [pltpu.VMEM((block_k, d), jnp.float32)] * (2 * hp)
+            ),
+            interpret=interpret,
+        )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args)
+        # dq pass: grid (b, h/hp, q blocks, k blocks) — the q/do/dq blocks
+        # are constant in the innermost (k) dimension.
+        q_blk2 = pl.BlockSpec((1, block_q, hp * d),
+                              lambda ib, ip, iq, ik: (ib, iq, ip))
+        kv_in2 = pl.BlockSpec(
+            (1, block_k, hp * d),
+            (lambda ib, ip, iq, ik: (ib, ik, ip // group)) if gqa_map
+            else (lambda ib, ip, iq, ik: (ib, ik, ip)),
+        )
+        row_q2 = pl.BlockSpec((1, hp, 1, block_q),
+                              lambda ib, ip, iq, ik: (ib, ip, 0, iq))
+        rope_q = [pl.BlockSpec((block_q, d),
+                               lambda ib, ip, iq, ik: (iq, 0))] * 2
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, **kernel_kw),
+            grid=(b, h // hp, s // block_q, s // block_k),
+            in_specs=[_seed_spec(), q_blk2, kv_in2, kv_in2, q_blk2, row_q2,
+                      row_q2] + (rope_q if fuse_rope else []),
+            out_specs=q_blk2,
+            out_shape=jax.ShapeDtypeStruct((b, s, h * d), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)] * hp,
+            interpret=interpret,
+        )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args)
+        if group > 1:
+            dk = dk.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
+                b, s, kvh * d).astype(k3.dtype)
+            dv = dv.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
+                b, s, kvh * d).astype(v3.dtype)
+        return dq.astype(q3.dtype), dk, dv
+
+    # Fused single pass; dq accumulates in f32 across kv-block grid steps
+    # (its block index is constant in that dimension, so it stays in VMEM).
+    # Under fused rope, dq and dk are un-rotated *inside* the kernel (VMEM)
+    # before they are written — no external pass over the gradients.
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, block_q=block_q, scale=scale,
                           causal=causal, dropout_rate=dropout_rate,
@@ -742,7 +1050,8 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
 def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
                 dropout_rate: float, num_heads: int, head_dim: int,
                 fuse_rope: bool, return_lse: bool = False,
-                num_kv_heads: Optional[int] = None):
+                num_kv_heads: Optional[int] = None,
+                backward: Optional[str] = None):
     """custom_vjp'd kernel entry over *folded* ``[b, s, h*d]`` operands.
 
     The fold matters twice. Memory: with head_dim 64, BSHD/BHSD tensors
@@ -773,20 +1082,13 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
     kw = dict(causal=causal, block_q=block_q, block_k=block_k,
               interpret=interpret, dropout_rate=dropout_rate,
               num_heads=h, head_dim=d, num_kv_heads=kernel_kvh)
-    bwd_kw = dict(kw, f32_kv_grads=expand_kv)
-    # The backward takes its preferred block shape. Safe under dropout
-    # too: hardware-PRNG masks generate in fixed 512x512 tiles keyed by
-    # absolute coordinates (see _keep), so any pair of 512-divisible (or
-    # equal) fwd/bwd block shapes sees identical masks — the overrides
-    # below only fire when blocks are 512-divisible. 512x512 wins for the
-    # backward with or without dropout: causal block-skipping computes
-    # 3/4 of the score square, and the paired program's f32 [bq, bk]
-    # working set stays inside the 16 MB scoped-VMEM budget (single
-    # 1024x1024 blocks blow it).
-    bwd_kw["block_q"] = (_BWD_BLOCK if block_q % _BWD_BLOCK == 0
-                         else block_q)
-    bwd_kw["block_k"] = (_BWD_BLOCK if block_k % _BWD_BLOCK == 0
-                         else block_k)
+    bwd_kw = dict(kw, f32_kv_grads=expand_kv, backward=backward)
+    # Backward block shapes are chosen per-path inside _flash_backward
+    # (the fused pass prefers 512 blocks, the split kernels keep the
+    # caller's). Safe under dropout either way: hardware-PRNG masks
+    # generate in fixed 512x512 tiles keyed by absolute coordinates (see
+    # _keep), so any pair of 512-divisible (or equal) fwd/bwd block
+    # shapes sees identical masks.
 
     def _expand(x3):
         if not expand_kv:
@@ -881,6 +1183,7 @@ def flash_attention(
     dropout_rng: Optional[jax.Array] = None,
     rope: Optional[tuple] = None,
     return_lse: bool = False,
+    backward: Optional[str] = None,
 ) -> jax.Array:
     """Blockwise causal flash attention; BSHD in, BSHD out.
 
@@ -893,10 +1196,25 @@ def flash_attention(
     fused attention when the sequence length doesn't tile (the kernel
     requires ``seq % block == 0``) — e.g. odd-length generate windows —
     applying rope externally there.
+
+    ``backward`` selects the backward kernel: ``"fused"`` (single pass,
+    full-row dq residency), ``"split"`` (two-kernel dkv + dq passes,
+    s-independent VMEM), or ``None``/``"auto"`` — fused for
+    s <= ``_FUSED_BWD_MAX_SEQ``, split beyond, overridable via the
+    ``TPU_TRAINER_FLASH_BWD`` env var (the sweep's knob).
     """
     b, s, h, d = q.shape
     if dropout_rate > 0.0 and dropout_rng is None:
         raise ValueError("dropout_rate > 0 requires dropout_rng")
+    if backward is None:
+        backward = (os.environ.get("TPU_TRAINER_FLASH_BWD", "").lower()
+                    or None)
+    if backward == "auto":
+        backward = None
+    if backward not in (None, "fused", "split"):
+        raise ValueError(
+            f"backward must be 'fused', 'split' or 'auto'; got {backward!r}"
+        )
     if h % k.shape[2] != 0:
         raise ValueError(
             f"num_heads {h} not divisible by num_kv_heads {k.shape[2]}"
@@ -928,17 +1246,18 @@ def flash_attention(
     # k blocks). Measured on v5e at s=2048: the 1024-block streaming
     # forward needs 18.9 MB and OOMs the scope, so DEFAULT streaming caps
     # at the 512 shape (the round-2 default; the backward already runs
-    # 512s) — UNLESS the caller raised the scoped-VMEM limit
-    # (``LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=...``, which
-    # bench.py does for s > 2048): under the raised scope the 1024 blocks
-    # fit and measure ~18% faster at s=4096
-    # (benchmarks/longseq_block_sweep.py). Explicitly-passed block sizes
-    # are always honored.
-    import os as _os
+    # 512s) — UNLESS the caller raised the scoped-VMEM limit via
+    # ``LIBTPU_INIT_ARGS=--xla_tpu_scoped_vmem_limit_kib=...``: under a
+    # raised scope the 1024 blocks fit and measure ~18% faster at s=4096
+    # (benchmarks/longseq_block_sweep.py). Nothing in this repo raises the
+    # flag anymore — the split backward made long sequences fit the
+    # default scope, and bench.py dropped its raise — but an explicit
+    # user raise is still honored. Explicitly-passed block sizes are
+    # always honored.
     import re as _re
 
     _m = _re.search(r"scoped_vmem_limit_kib=(\d+)",
-                    _os.environ.get("LIBTPU_INIT_ARGS", ""))
+                    os.environ.get("LIBTPU_INIT_ARGS", ""))
     # 1024-block streaming needs ~19 MB of scope: only an explicit limit
     # comfortably above that counts as "raised" (a pinned 16 MB default
     # must still get the 512 cap).
@@ -1012,7 +1331,7 @@ def flash_attention(
         kvh = h_k
     fn = _make_flash(
         causal, block_q, block_k, interpret, float(dropout_rate), h_k, d,
-        fuse_rope, return_lse, kvh,
+        fuse_rope, return_lse, kvh, backward,
     )
     # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals).
     out = fn(

@@ -24,6 +24,7 @@ import optax
 
 from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.utils.quant import (
+    QuantPack,
     dequantize_blockwise_int8,
     quantize_blockwise_int8,
 )
@@ -56,7 +57,7 @@ class ScaleByAdamQState(NamedTuple):
     """Adam state with narrow-dtype moments (``optimizer_state_dtype``)."""
 
     count: jax.Array  # int32 scalar
-    mu: Any           # per-leaf: f32 array | bf16 array | int8 pack dict
+    mu: Any           # per-leaf: f32 array | bf16 array | int8 QuantPack
     nu: Any
 
 
@@ -71,7 +72,7 @@ def _store_moment(x: jax.Array, state_dtype: str, *, nonneg: bool):
 
 
 def _load_moment(packed, shape, *, nonneg: bool) -> jax.Array:
-    if isinstance(packed, dict):
+    if isinstance(packed, QuantPack):
         return dequantize_blockwise_int8(packed, shape, jnp.float32,
                                          nonneg=nonneg)
     return packed.astype(jnp.float32)
@@ -120,12 +121,11 @@ def scale_by_adam_quantized(
         c2 = 1.0 - b2 ** count_inc.astype(jnp.float32)
 
         # Flatten against the GRADS' structure: a quantized moment is a
-        # {"q", "scale"} dict subtree where the grads have an array leaf,
-        # so the moment trees flatten with an is-pack leaf predicate
-        # (exact-key match — params pytrees are dicts too).
-        is_pack = lambda x: (  # noqa: E731
-            isinstance(x, dict) and set(x) == {"q", "scale"}
-        )
+        # QuantPack node where the grads have an array leaf, so the moment
+        # trees flatten with an is-leaf predicate on the pack TYPE. A
+        # params subtree that happened to use the keys {"q", "scale"}
+        # cannot be mistaken for a pack and misalign this positional zip.
+        is_pack = lambda x: isinstance(x, QuantPack)  # noqa: E731
         g_leaves, treedef = jax.tree_util.tree_flatten(updates)
         mu_leaves = jax.tree_util.tree_flatten(state.mu, is_leaf=is_pack)[0]
         nu_leaves = jax.tree_util.tree_flatten(state.nu, is_leaf=is_pack)[0]

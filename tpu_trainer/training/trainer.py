@@ -90,6 +90,7 @@ _linked_cast.defvjp(_linked_cast_fwd, _linked_cast_bwd)
 # on-device quantized Adam state); re-exported here for its established
 # import path (tests/test_offload.py, validate.py).
 from tpu_trainer.utils.quant import (  # noqa: E402,F401
+    QuantPack,
     dequantize_blockwise_int8,
     quantize_blockwise_int8,
 )
@@ -103,7 +104,8 @@ def _path_keys(path) -> tuple:
     )
 
 
-def select_resident_moments(opt_shapes, budget_bytes: int):
+def select_resident_moments(opt_shapes, budget_bytes: int,
+                            shard_count: int = 1):
     """Partial-offload selection: which optimizer-state leaves stay on
     device under a byte budget (VERDICT r4 #3).
 
@@ -113,13 +115,25 @@ def select_resident_moments(opt_shapes, budget_bytes: int):
     together or not at all only by budget coincidence — fine, each leaf
     streams independently). Scalars never stream anyway. Returns
     ``(frozenset of path-key tuples, bytes kept)``.
+
+    ``shard_count`` is the fsdp axis size under zero2/zero3, where the
+    moments are fsdp-sharded: a kept leaf then costs ``size /
+    shard_count`` bytes of *per-device* HBM, which is what the
+    ``--opt_resident_gb`` budget and the startup line describe. Leaves no
+    dim of which divides the axis stay replicated and cost full size
+    (same shape-only rule as ``shard_lib.fsdp_spec``; a leaf that is
+    *additionally* tensor-sharded is counted conservatively at its
+    fsdp-only shard size).
     """
     cands = []
     for path, leaf in jax.tree_util.tree_flatten_with_path(opt_shapes)[0]:
         if (getattr(leaf, "ndim", 0) >= 1
                 and jnp.issubdtype(leaf.dtype, jnp.floating)):
-            cands.append((_path_keys(path),
-                          leaf.size * jnp.dtype(leaf.dtype).itemsize))
+            size = leaf.size * jnp.dtype(leaf.dtype).itemsize
+            if (shard_count > 1 and shard_lib.FSDP_AXIS
+                    in tuple(shard_lib.fsdp_spec(leaf.shape, shard_count))):
+                size = -(-size // shard_count)  # ceil: per-device bytes
+            cands.append((_path_keys(path), size))
     cands.sort(key=lambda kv: (-kv[1], kv[0]))
     keep, used = set(), 0
     for pk, sz in cands:
@@ -409,10 +423,18 @@ class Trainer:
                 jax.random.PRNGKey(0),
             )
             opt_shapes = jax.eval_shape(self.optimizer.init, p_shapes)
+            # Under zero2/zero3 the moments are fsdp-sharded: budget the
+            # PER-DEVICE shard bytes, not the global leaf bytes, so
+            # --opt_resident_gb and the startup line match actual HBM.
+            fsdp_shards = (
+                self.mesh.shape[shard_lib.FSDP_AXIS]
+                if self.strategy in ("zero2", "zero3") else 1
+            )
             self._offload_keep, self.offload_resident_bytes = (
                 select_resident_moments(
                     opt_shapes,
                     int(parallel_config.offload_budget_gb * 2**30),
+                    shard_count=fsdp_shards,
                 )
             )
 
@@ -549,8 +571,10 @@ class Trainer:
 
     @staticmethod
     def _is_packed(x) -> bool:
-        return (isinstance(x, dict) and set(x) == {"q", "scale"}
-                and getattr(x.get("q"), "dtype", None) == jnp.int8)
+        # Type check, not a dict-key heuristic: QuantPack is a registered
+        # pytree node, so a params subtree using the same keys can never
+        # be misread as a quantized moment.
+        return isinstance(x, QuantPack)
 
     @staticmethod
     def _path_nonneg(path) -> bool:

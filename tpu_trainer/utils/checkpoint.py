@@ -424,8 +424,8 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
                 f"--no_auto_resume to start over, or match the saved config"
             )
     # A different on-device Adam storage dtype changes the opt_state TREE
-    # (quantized moments are {"q", "scale"} packs) — fail with the knob's
-    # name instead of an orbax structure error.
+    # (quantized moments are QuantPack nodes — utils/quant.py) — fail with
+    # the knob's name instead of an orbax structure error.
     saved_tc = meta.get("training_config") or {}
     saved_osd = saved_tc.get("optimizer_state_dtype", "float32")
     now_osd = trainer.training_config.optimizer_state_dtype

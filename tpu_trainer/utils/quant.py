@@ -27,6 +27,34 @@ import jax.numpy as jnp
 QUANT_BLOCK = 256  # target block length along the last dim
 
 
+@jax.tree_util.register_pytree_with_keys_class
+class QuantPack(dict):
+    """A blockwise-int8 quantized tensor: ``{"q": int8, "scale": f32}``.
+
+    Registered as its own pytree node so consumers identify packs by TYPE
+    (``isinstance(x, QuantPack)``) rather than by dict-key heuristics — a
+    params subtree that happens to use the keys ``{"q", "scale"}`` can no
+    longer be mistaken for a quantized moment and silently misalign grads
+    with moments in the optimizer's positional flatten. It subclasses
+    ``dict`` and flattens with ``DictKey`` paths, so indexing
+    (``pack["q"]``), sharding-spec suffix matching, and orbax checkpoint
+    naming all see exactly what a plain dict would.
+    """
+
+    def tree_flatten_with_keys(self):
+        return (
+            ((jax.tree_util.DictKey("q"), self["q"]),
+             (jax.tree_util.DictKey("scale"), self["scale"])),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux_data, children):
+        del aux_data
+        q, scale = children
+        return cls(q=q, scale=scale)
+
+
 def quant_block_len(d: int) -> int:
     """Largest of {256, 128, 64, 32} dividing ``d`` (else ``d`` itself —
     one block per row)."""
@@ -36,12 +64,12 @@ def quant_block_len(d: int) -> int:
     return d
 
 
-def quantize_blockwise_int8(x: jax.Array, *, nonneg: bool) -> dict:
+def quantize_blockwise_int8(x: jax.Array, *, nonneg: bool) -> "QuantPack":
     """Blockwise absmax int8 quantization along the LAST dim.
 
     ``nonneg`` (Adam's second moment): quantize ``sqrt(x)`` instead (see
-    module docstring). Returns ``{"q": int8 [..., nb, B], "scale": f32
-    [..., nb]}``.
+    module docstring). Returns a ``QuantPack`` — ``{"q": int8
+    [..., nb, B], "scale": f32 [..., nb]}``.
     """
     d = x.shape[-1]
     blk = quant_block_len(d)
@@ -52,10 +80,10 @@ def quantize_blockwise_int8(x: jax.Array, *, nonneg: bool) -> dict:
     scale = jnp.max(jnp.abs(y), axis=-1) / 127.0
     safe = jnp.maximum(scale, 1e-30)
     q = jnp.round(y / safe[..., None]).astype(jnp.int8)
-    return {"q": q, "scale": scale}
+    return QuantPack(q=q, scale=scale)
 
 
-def dequantize_blockwise_int8(packed: dict, shape, dtype, *,
+def dequantize_blockwise_int8(packed: "QuantPack", shape, dtype, *,
                               nonneg: bool) -> jax.Array:
     y = packed["q"].astype(jnp.float32) * packed["scale"][..., None]
     if nonneg:
