@@ -6,7 +6,12 @@ windowed semantics, ``generate_bucketed`` = compile-shape bucketing,
 variant and batch>1 rows for the cached path. Timing: best of 3 windows,
 one warm call first (compile excluded), wall clock over generated tokens.
 
-    python benchmarks/decode_bench.py [--model-size small] [--rounds 3]
+    python benchmarks/decode_bench.py [--model-size small] [--rounds 3] \
+        [--out decode.jsonl]
+
+``--out`` appends the same record as a schema-versioned JSONL line
+(``kind="decode"``) that ``python -m tpu_trainer.tools.analyze``
+summarizes and ``--compare`` gates (kv-path tok/s regression fails CI).
 
 Reference anchor: the O(S^2) per-token full re-forward loop at
 ``/root/reference/src/eval/infer.py:60-66``.
@@ -42,6 +47,8 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--model-size", default="small")
     p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--out", default=None,
+                   help="append the schema-versioned record to this JSONL")
     args = p.parse_args()
 
     import jax
@@ -99,16 +106,26 @@ def main() -> None:
     print(f"prompt 768, +128   kv-gqa3   bs=1  {128 / dt:8.0f} tok/s",
           flush=True)
 
-    # Machine-readable record (the same contract as bench.py's JSON line).
+    # Machine-readable record (the same contract as bench.py's JSON line),
+    # schema-stamped so tools/analyze.py can summarize and gate it.
     import json
 
-    print(json.dumps({
+    from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+    record = {
+        "kind": "decode",
+        "schema_version": SCHEMA_VERSION,
         "metric": "decode_tok_per_sec",
+        "model_size": args.model_size,
         "rows": [
             {"setting": s, "path": p, "batch": b, "tok_per_sec": round(t, 1)}
             for s, p, b, t in rows
         ],
-    }), flush=True)
+    }
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,223 @@
+"""Serving benchmark: continuous batching vs sequential decode.
+
+Replays a seeded open-loop Poisson trace through the serving engine
+(``tpu_trainer.serving``) and reports aggregate tokens/s, p50/p99 TTFT
+(arrival -> first token) and per-token latency (TPOT), KV-pool occupancy
+and preemptions — then runs the same requests as sequential batch-1
+``generate_kv`` calls, the "one request at a time" baseline continuous
+batching exists to beat.
+
+    python benchmarks/serve_bench.py [--requests 32] [--concurrency 8] \
+        [--out serve.jsonl]
+    python benchmarks/serve_bench.py --smoke          # CPU CI gate
+
+Results go to stdout as a table plus one schema-versioned JSON record
+(``kind="serve"``); ``--out`` appends the record to a JSONL file that
+``python -m tpu_trainer.tools.analyze`` summarizes and ``--compare``
+gates. ``--smoke`` shrinks everything to a 16-request trace on a tiny
+model (CI runs it under ``JAX_PLATFORMS=cpu``) and exits nonzero when
+p99 TTFT breaks the ``--ttft-p99-gate`` bound or the trace fails to
+drain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--concurrency", type=int, default=8,
+                   help="engine slot batch (max concurrent requests)")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="Poisson arrival rate, req/s (<= 0: all at t=0)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompt-len", default="32,64",
+                   help="min,max prompt length (uniform)")
+    p.add_argument("--max-new", type=int, default=32,
+                   help="tokens generated per request (uniform, so the "
+                        "sequential baseline compiles once)")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=0,
+                   help="KV pool blocks (0 = full-context sizing)")
+    p.add_argument("--kv-int8", action="store_true")
+    p.add_argument("--attention", default="auto",
+                   choices=("auto", "reference", "kernel"))
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--max-seq-len", type=int, default=256)
+    p.add_argument("--no-baseline", action="store_true",
+                   help="skip the sequential generate_kv comparison")
+    p.add_argument("--out", default=None,
+                   help="append the schema-versioned record to this JSONL")
+    p.add_argument("--smoke", action="store_true",
+                   help="16-request tiny-model CI gate (implies "
+                        "--no-baseline)")
+    p.add_argument("--ttft-p99-gate", type=float, default=0.0,
+                   help="seconds; > 0 gates p99 TTFT and exits 1 past it "
+                        "(--smoke defaults this to 60)")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        args.requests = 16
+        args.concurrency = 4
+        args.hidden, args.layers, args.heads = 64, 2, 2
+        args.vocab, args.max_seq_len = 256, 64
+        args.prompt_len, args.max_new = "4,12", 8
+        args.block_size = 8
+        args.no_baseline = True
+        if args.ttft_p99_gate == 0.0:
+            args.ttft_p99_gate = 60.0
+
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_trainer.models.config import GPTConfig
+    from tpu_trainer.models.gpt import GPT, generate_kv
+    from tpu_trainer.serving.engine import (
+        ServingEngine, poisson_trace, request_metrics)
+    from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+    plo, phi = (int(x) for x in args.prompt_len.split(","))
+    cfg = GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=args.heads,
+        max_seq_len=args.max_seq_len, dropout=0.0, attention_dropout=0.0,
+        dtype="float32", param_dtype="float32",
+    )
+    params = GPT(cfg).init(
+        jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    def make_trace():
+        # Fresh Request objects each run (the engine mutates them);
+        # greedy sampling so both paths do identical per-token work.
+        trace = poisson_trace(
+            args.requests, vocab_size=args.vocab,
+            rate=args.rate if args.rate > 0 else 1.0, seed=args.seed,
+            prompt_len_range=(plo, phi),
+            max_new_range=(args.max_new, args.max_new), temperature=0.0,
+        )
+        if args.rate <= 0:
+            for r in trace:
+                r.arrival_time = 0.0
+        return trace
+
+    engine = ServingEngine(
+        params, cfg, max_batch=args.concurrency,
+        block_size=args.block_size, num_blocks=args.num_blocks or None,
+        kv_int8=args.kv_int8, attention=args.attention,
+    )
+    engine.run(make_trace())          # warm-up: compiles every step shape
+    engine.reset_stats()
+    finished = engine.run(make_trace())
+    summary = engine.summary()
+    lat = request_metrics(finished)
+    drained = all(len(r.generated) >= min(r.max_new_tokens, 1)
+                  for r in finished)
+
+    record = {
+        "kind": "serve",
+        "schema_version": SCHEMA_VERSION,
+        "n_requests": args.requests,
+        "concurrency": args.concurrency,
+        "rate": args.rate,
+        "block_size": args.block_size,
+        "kv_int8": bool(args.kv_int8),
+        "attention": args.attention,
+        "model": {"hidden": args.hidden, "layers": args.layers,
+                  "heads": args.heads, "vocab": args.vocab},
+        "tokens_per_s": round(summary["tokens_per_s"], 2),
+        "generated_tokens": int(summary["generated_tokens"]),
+        "wall_s": round(summary["wall_s"], 4),
+        "occupancy_mean": round(summary["occupancy_mean"], 4),
+        "occupancy_max": round(summary["occupancy_max"], 4),
+        "preemptions": int(summary["preemptions"]),
+        "prefill_iters": int(summary["prefill_iters"]),
+        "decode_iters": int(summary["decode_iters"]),
+    }
+    for name, series in lat.items():
+        if series:
+            record[f"{name}_p50_s"] = round(float(np.percentile(series, 50)), 5)
+            record[f"{name}_p99_s"] = round(float(np.percentile(series, 99)), 5)
+
+    if not args.no_baseline:
+        # Sequential baseline: the SAME requests, one batch-1 greedy
+        # generate_kv call each. Prompts pad to one shared width
+        # (prompt_lens carries the true length) and max_new is uniform,
+        # so the whole loop is one compile, warmed before timing.
+        trace = make_trace()
+        width = max(len(r.prompt) for r in trace)
+        rows = np.zeros((len(trace), width), np.int32)
+        lens = np.zeros((len(trace),), np.int32)
+        for i, r in enumerate(trace):
+            rows[i, : len(r.prompt)] = r.prompt
+            lens[i] = len(r.prompt)
+
+        def one(i):
+            out = generate_kv(
+                params, jax.random.PRNGKey(0), jnp.asarray(rows[i:i + 1]),
+                config=cfg, max_new_tokens=args.max_new, temperature=0.0,
+                top_k=1, prompt_lens=jnp.asarray(lens[i:i + 1]),
+            )
+            return int(out[-1, -1])   # host read = hard sync
+
+        one(0)                        # warm
+        t0 = time.perf_counter()
+        for i in range(len(trace)):
+            one(i)
+        dt = time.perf_counter() - t0
+        seq_tok_s = len(trace) * args.max_new / dt
+        record["sequential_tokens_per_s"] = round(seq_tok_s, 2)
+        record["concurrent_speedup"] = round(
+            record["tokens_per_s"] / seq_tok_s, 3)
+
+    print(f"serve   {record['tokens_per_s']:10.1f} tok/s over "
+          f"{record['n_requests']} reqs (concurrency "
+          f"{record['concurrency']}, {record['generated_tokens']} tokens, "
+          f"{record['wall_s']:.2f}s)", flush=True)
+    if "ttft_p50_s" in record:
+        print(f"TTFT    p50 {record['ttft_p50_s'] * 1e3:8.1f} ms   "
+              f"p99 {record['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
+    if "tpot_p50_s" in record:
+        print(f"TPOT    p50 {record['tpot_p50_s'] * 1e3:8.1f} ms   "
+              f"p99 {record['tpot_p99_s'] * 1e3:8.1f} ms", flush=True)
+    print(f"pool    occupancy mean {record['occupancy_mean']:.2f} "
+          f"max {record['occupancy_max']:.2f}, "
+          f"{record['preemptions']} preemptions", flush=True)
+    if "sequential_tokens_per_s" in record:
+        print(f"serial  {record['sequential_tokens_per_s']:10.1f} tok/s "
+              f"sequential generate_kv -> {record['concurrent_speedup']:.2f}x "
+              f"from batching", flush=True)
+    print(json.dumps(record), flush=True)
+
+    if args.out:
+        with open(args.out, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+
+    failures = []
+    if not drained:
+        failures.append("trace did not drain (unfinished requests)")
+    if args.ttft_p99_gate > 0:
+        p99 = record.get("ttft_p99_s")
+        if p99 is None or p99 > args.ttft_p99_gate:
+            failures.append(
+                f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
+    for f in failures:
+        print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

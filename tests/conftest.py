@@ -62,5 +62,7 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
             if module in _HEAVY_MODULES:
                 item.add_marker(pytest.mark.heavy)
-        else:
+        elif item.get_closest_marker("slow") is None:
+            # Don't put an explicitly-@slow test (e.g. the serving soak in
+            # test_serving) in the fast lane just because its module is.
             item.add_marker(pytest.mark.fast)

@@ -1,0 +1,423 @@
+"""Serving subsystem tests (ISSUE 6): paged KV cache + flash-decode +
+continuous batching.
+
+Tier-1 (this module is NOT in conftest's _SLOW_MODULES) covers the whole
+stack on CPU: the Pallas flash-decode kernel in interpret mode against
+the pure-jnp reference and a dense recomputation, the block pool / cache
+bookkeeping, and the engine itself — greedy token streams must BIT-MATCH
+``generate_kv`` for mixed prompt lengths, replay must be deterministic,
+admission must respect the block budget, and preempted requests must
+resume with identical continuations. The 1k-request soak is the explicit
+``@pytest.mark.slow`` exception.
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT, _sample, generate_kv
+from tpu_trainer.ops.flash import flash_decode, paged_attention_reference
+from tpu_trainer.serving import (
+    BlockPool,
+    PagedKVCache,
+    Request,
+    SamplingParams,
+    ServingEngine,
+)
+from tpu_trainer.serving.engine import poisson_trace
+from tpu_trainer.serving.sampling import request_key, sample_tokens
+from tpu_trainer.utils.quant import quantize_kv_int8
+
+
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+                dtype="float32", param_dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _requests(plens, max_new=8, temperature=0.0, top_k=0):
+    rs = np.random.RandomState(1)
+    return [
+        Request(
+            rid=i,
+            prompt=rs.randint(1, CFG.vocab_size, size=p).tolist(),
+            max_new_tokens=max_new,
+            sampling=SamplingParams(temperature=temperature, top_k=top_k,
+                                    seed=100 + i),
+        )
+        for i, p in enumerate(plens)
+    ]
+
+
+def _greedy_reference(params, plens, max_new=8):
+    """generate_kv greedy streams for the same prompts (ragged batch)."""
+    reqs = _requests(plens, max_new)
+    width = max(plens)
+    ids = np.zeros((len(plens), width), np.int32)
+    for i, r in enumerate(reqs):
+        ids[i, : len(r.prompt)] = r.prompt
+    out = np.asarray(generate_kv(
+        params, jax.random.PRNGKey(7), jnp.asarray(ids), config=CFG,
+        max_new_tokens=max_new, temperature=0.0, top_k=1,
+        prompt_lens=jnp.asarray(plens, jnp.int32),
+    ))
+    return [out[i, p:p + max_new].tolist() for i, p in enumerate(plens)]
+
+
+# --- flash-decode kernel vs reference vs dense -----------------------------
+
+def _paged_fixture(b=3, h=4, kvh=2, d=16, bsz=8, mb=3, nblk=12,
+                   lengths=(1, 10, 24)):
+    rs = np.random.RandomState(0)
+    q = rs.standard_normal((b, h, d)).astype(np.float32)
+    pool_k = rs.standard_normal((nblk, bsz, kvh, d)).astype(np.float32)
+    pool_v = rs.standard_normal((nblk, bsz, kvh, d)).astype(np.float32)
+    tables = rs.permutation(np.arange(1, nblk))[: b * mb]
+    tables = tables.reshape(b, mb).astype(np.int32)
+    lengths = np.asarray(lengths, np.int32)
+    return q, pool_k, pool_v, tables, lengths
+
+
+def _dense(q, pool_k, pool_v, tables, lengths):
+    b, h, d = q.shape
+    kvh = pool_k.shape[2]
+    out = np.zeros_like(q)
+    for r in range(b):
+        L = int(lengths[r])
+        k = pool_k[tables[r]].reshape(-1, kvh, d)[:L]
+        v = pool_v[tables[r]].reshape(-1, kvh, d)[:L]
+        k = np.repeat(k, h // kvh, axis=1)
+        v = np.repeat(v, h // kvh, axis=1)
+        s = np.einsum("hd,lhd->hl", q[r], k) / np.sqrt(d)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[r] = np.einsum("hl,lhd->hd", p, v)
+    return out
+
+
+class TestFlashDecode:
+    def test_reference_matches_dense(self):
+        q, pk, pv, tb, ln = _paged_fixture()
+        ref = paged_attention_reference(q, pk, pv, tb, ln)
+        np.testing.assert_allclose(np.asarray(ref), _dense(q, pk, pv, tb, ln),
+                                   atol=1e-5)
+
+    def test_kernel_matches_reference_fp(self):
+        q, pk, pv, tb, ln = _paged_fixture()
+        ref = paged_attention_reference(q, pk, pv, tb, ln)
+        out = flash_decode(q, pk, pv, tb, ln, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_kernel_split_merge_odd_splits(self):
+        # mb=3 -> 3 KV splits; the length-1 row leaves two splits empty,
+        # exercising the m=-inf online-softmax merge path.
+        q, pk, pv, tb, ln = _paged_fixture(lengths=(1, 17, 24))
+        ref = paged_attention_reference(q, pk, pv, tb, ln)
+        out = flash_decode(q, pk, pv, tb, ln, n_splits=3, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_int8_kernel_matches_int8_reference(self):
+        q, pk, pv, tb, ln = _paged_fixture()
+        qk, sk = quantize_kv_int8(jnp.asarray(pk))
+        qv, sv = quantize_kv_int8(jnp.asarray(pv))
+        ref = paged_attention_reference(q, qk, qv, tb, ln,
+                                        k_scale=sk, v_scale=sv)
+        out = flash_decode(q, qk, qv, tb, ln, k_scale=sk, v_scale=sv,
+                           interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_int8_within_documented_tolerance(self):
+        # config.py documents ~1e-2 attention-output error for int8 KV on
+        # unit-scale data (measured 1.1e-2); gate at 5e-2.
+        q, pk, pv, tb, ln = _paged_fixture()
+        fp = paged_attention_reference(q, pk, pv, tb, ln)
+        qk, sk = quantize_kv_int8(jnp.asarray(pk))
+        qv, sv = quantize_kv_int8(jnp.asarray(pv))
+        i8 = paged_attention_reference(q, qk, qv, tb, ln,
+                                       k_scale=sk, v_scale=sv)
+        err = float(jnp.max(jnp.abs(fp - i8)))
+        assert err < 5e-2, err
+
+
+# --- pool / cache bookkeeping ----------------------------------------------
+
+class TestBlockPool:
+    def test_alloc_reclaim_roundtrip(self):
+        pool = BlockPool(8)
+        a = pool.alloc(3)
+        b = pool.alloc(4)
+        assert a is not None and b is not None
+        assert sorted(a + b) == list(range(1, 8))   # block 0 reserved
+        assert pool.alloc(1) is None                # dry pool, untouched
+        assert pool.occupancy == 1.0
+        pool.free(a)
+        pool.free(b)
+        assert pool.free_blocks == 7
+        assert pool.occupancy == 0.0
+
+    def test_double_free_raises(self):
+        pool = BlockPool(4)
+        a = pool.alloc(1)
+        pool.free(a)
+        with pytest.raises(ValueError):
+            pool.free(a)
+        with pytest.raises(ValueError):
+            pool.free([0])   # the null block is never allocatable
+
+    def test_cache_release_zeroes_slot(self):
+        cfg = dataclasses.replace(
+            CFG, decode_paged=True, paged_block_size=8,
+            paged_num_blocks=10, paged_max_blocks=4)
+        cache = PagedKVCache(cfg, slots=2)
+        assert cache.blocks_for(1) == 1 and cache.blocks_for(17) == 3
+        blocks = cache.pool.alloc(cache.blocks_for(20))
+        cache.assign(1, blocks)
+        cache.lengths[1] = 20
+        assert cache.slot_blocks(1) == blocks
+        cache.release(1)
+        assert cache.pool.occupancy == 0.0
+        assert cache.lengths[1] == 0 and not cache.slot_blocks(1)
+
+
+# --- sampling --------------------------------------------------------------
+
+class TestSampling:
+    def test_model_sample_temperature_zero_is_greedy(self):
+        # Regression: temperature 0 used to divide by zero and sample NaN.
+        logits = jnp.asarray(np.random.RandomState(0)
+                             .standard_normal((4, 33)).astype(np.float32))
+        out = _sample(logits, jax.random.PRNGKey(5), 0.0, 50)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.argmax(logits, axis=-1)))
+
+    def test_sample_tokens_greedy_rows(self):
+        logits = jnp.asarray(np.random.RandomState(1)
+                             .standard_normal((3, 16)).astype(np.float32))
+        toks = sample_tokens(
+            logits, jnp.zeros(3), jnp.zeros(3, jnp.int32),
+            jnp.asarray(np.stack([request_key(s) for s in (1, 2, 3)])),
+            jnp.zeros(3, jnp.int32), k_cap=4)
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(jnp.argmax(logits, axis=-1)))
+
+    def test_sample_tokens_batch_invariant(self):
+        # A row's draw depends only on (its logits, seed, step) — not on
+        # batch position, neighbors, or the engine's current k_cap. This
+        # is the property that makes preemption/resume exact.
+        rs = np.random.RandomState(2)
+        row = rs.standard_normal((1, 40)).astype(np.float32)
+        key = request_key(9)
+
+        def draw(batch_rows, pos, k_cap):
+            lg = np.asarray(batch_rows, np.float32)
+            b = lg.shape[0]
+            temps = jnp.full((b,), 0.7)
+            ks = jnp.full((b,), 5, jnp.int32)
+            keys = np.tile(request_key(0), (b, 1))
+            keys[pos] = key
+            toks = sample_tokens(jnp.asarray(lg), temps, ks,
+                                 jnp.asarray(keys),
+                                 jnp.full((b,), 3, jnp.int32), k_cap=k_cap)
+            return int(toks[pos])
+
+        alone = draw(row, 0, k_cap=5)
+        crowded = draw(np.concatenate(
+            [rs.standard_normal((3, 40)).astype(np.float32), row]), 3,
+            k_cap=50)
+        assert alone == crowded
+
+
+# --- engine ----------------------------------------------------------------
+
+PLENS = [5, 11, 16, 3]
+
+
+class TestEngineParity:
+    @pytest.mark.parametrize("attention", ["reference", "kernel"])
+    def test_greedy_bit_matches_generate_kv(self, params, attention):
+        ref = _greedy_reference(params, PLENS)
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            attention=attention)
+        fin = eng.run(_requests(PLENS), time_mode="steps")
+        assert [r.generated for r in fin] == ref
+        assert eng.cache_state.pool.occupancy == 0.0
+
+    def test_int8_engine_smoke(self, params):
+        # int8 KV is a lossy cache (documented ~1e-2 op tolerance, gated
+        # above at the op level): here the engine must run, drain, and
+        # produce in-vocab tokens.
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            kv_int8=True, attention="reference")
+        fin = eng.run(_requests(PLENS), time_mode="steps")
+        for r in fin:
+            assert len(r.generated) == r.max_new_tokens
+            assert all(0 <= t < CFG.vocab_size for t in r.generated)
+        assert eng.cache_state.pool.occupancy == 0.0
+
+    def test_eos_retires_early_and_reclaims(self, params):
+        probe = ServingEngine(params, CFG, max_batch=1, block_size=8)
+        first = probe.run(_requests([PLENS[0]]), time_mode="steps")
+        tok0 = first[0].generated[0]
+
+        eng = ServingEngine(params, CFG, max_batch=1, block_size=8)
+        reqs = _requests([PLENS[0]])
+        reqs[0].eos_id = tok0
+        fin = eng.run(reqs, time_mode="steps")
+        assert fin[0].generated == [tok0]
+        assert eng.cache_state.pool.occupancy == 0.0
+
+
+class TestEngineScheduling:
+    def test_deterministic_replay(self, params):
+        def run():
+            eng = ServingEngine(params, CFG, max_batch=2, block_size=8)
+            trace = poisson_trace(
+                6, vocab_size=CFG.vocab_size, rate=0.5, seed=11,
+                prompt_len_range=(3, 12), max_new_range=(4, 8),
+                temperature=0.9, top_k=20)
+            fin = eng.run(trace, time_mode="steps")
+            return [(r.rid, tuple(r.generated)) for r in fin]
+
+        assert run() == run()
+
+    def test_admission_never_exceeds_block_budget(self, params):
+        eng = ServingEngine(params, CFG, max_batch=4, block_size=8,
+                            num_blocks=6)
+        for r in _requests([5, 8, 14, 20, 6, 11], max_new=6,
+                           temperature=1.0):
+            eng.scheduler.add(r)
+        pool = eng.cache_state.pool
+        for _ in range(500):
+            if not eng.scheduler.has_work():
+                break
+            eng.step()
+            assert 0 <= pool.free_blocks <= pool.num_blocks - 1
+            for r in eng.scheduler.running:
+                nb = len(eng.cache_state.slot_blocks(r.slot))
+                assert nb <= eng.cache_state.max_blocks
+                assert nb * 8 >= r.cached_tokens()
+        assert not eng.scheduler.has_work()
+        assert pool.occupancy == 0.0
+
+    def test_preempted_requests_resume_identically(self, params):
+        def run(num_blocks):
+            eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                                num_blocks=num_blocks,
+                                attention="reference")
+            fin = eng.run(_requests(PLENS, temperature=0.9, top_k=20),
+                          time_mode="steps")
+            return [r.generated for r in fin], eng.scheduler.n_preemptions
+
+        roomy, p0 = run(None)
+        tight, p1 = run(5)
+        assert p0 == 0 and p1 > 0        # the tight pool actually preempted
+        assert tight == roomy            # ...without changing any stream
+
+        # Greedy parity vs generate_kv survives preemption too.
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            num_blocks=5, attention="reference")
+        fin = eng.run(_requests(PLENS), time_mode="steps")
+        assert eng.scheduler.n_preemptions > 0
+        assert [r.generated for r in fin] == _greedy_reference(params, PLENS)
+
+
+@pytest.mark.slow
+class TestSoak:
+    def test_1k_request_soak(self, params):
+        eng = ServingEngine(params, CFG, max_batch=8, block_size=8,
+                            num_blocks=24)
+        trace = poisson_trace(
+            1000, vocab_size=CFG.vocab_size, rate=50.0, seed=3,
+            prompt_len_range=(4, 20), max_new_range=(2, 8),
+            temperature=1.0)
+        fin = eng.run(trace, time_mode="steps", max_iters=100_000)
+        assert len(fin) == 1000
+        for r in fin:
+            assert len(r.generated) == r.max_new_tokens
+        assert eng.cache_state.pool.occupancy == 0.0
+        assert eng.stats["generated_tokens"] == sum(
+            r.max_new_tokens for r in fin)
+
+
+# --- benches + gates -------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestServeBench:
+    def test_smoke_passes(self):
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            import serve_bench
+        finally:
+            sys.path.pop(0)
+        assert serve_bench.main(["--smoke"]) == 0
+
+    @pytest.mark.slow
+    def test_gate_violation_exits_nonzero(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "benchmarks",
+                                          "serve_bench.py"),
+             "--smoke", "--ttft-p99-gate", "1e-9"],
+            capture_output=True, text=True, env=env, timeout=600)
+        assert proc.returncode == 1, proc.stderr
+        assert "GATE FAIL" in proc.stderr
+
+
+class TestAnalyzeGates:
+    SERVE = {"kind": "serve", "schema_version": 1, "tokens_per_s": 1000.0,
+             "ttft_p99_s": 0.05, "tpot_p99_s": 0.002, "n_requests": 16,
+             "concurrency": 4, "occupancy_mean": 0.5, "preemptions": 0}
+    DECODE = {"kind": "decode", "schema_version": 1, "rows": [
+        {"setting": "prompt 128, +256", "path": "kv", "batch": 1,
+         "tok_per_sec": 500.0},
+        {"setting": "prompt 128, +256", "path": "windowed", "batch": 1,
+         "tok_per_sec": 100.0}]}
+
+    @staticmethod
+    def _write(tmp_path, name, records):
+        import json
+        f = tmp_path / name
+        f.write_text("".join(json.dumps(r) + "\n" for r in records))
+        return str(f)
+
+    def test_serve_and_decode_summarize(self, tmp_path):
+        from tpu_trainer.tools.analyze import load_records, summarize
+
+        path = self._write(tmp_path, "run.jsonl", [self.SERVE, self.DECODE])
+        report = summarize(load_records(path))
+        assert report["serve"]["tokens_per_s"] == 1000.0
+        assert report["decode"]["kv_best_tok_per_sec"] == 500.0
+
+    def test_regression_fails_gate(self, tmp_path):
+        from tpu_trainer.tools.analyze import main as analyze_main
+
+        base = self._write(tmp_path, "base.jsonl", [self.SERVE, self.DECODE])
+        bad_serve = dict(self.SERVE, tokens_per_s=500.0, ttft_p99_s=0.2)
+        bad = self._write(tmp_path, "bad.jsonl", [bad_serve, self.DECODE])
+        assert analyze_main([base, "--compare", base]) == 0
+        assert analyze_main([bad, "--compare", base]) == 1
+
+    def test_unstamped_record_exits_2(self, tmp_path):
+        from tpu_trainer.tools.analyze import main as analyze_main
+
+        rec = {k: v for k, v in self.SERVE.items() if k != "schema_version"}
+        path = self._write(tmp_path, "old.jsonl", [rec])
+        assert analyze_main([path]) == 2

@@ -54,6 +54,15 @@ def main(argv=None) -> int:
     p.add_argument("--prompt_file", default=None,
                    help="file with one prompt per line: decoded as ONE "
                         "ragged batch (per-row lengths; KV cache path)")
+    p.add_argument("--serve", action="store_true",
+                   help="decode through the continuous-batching serving "
+                        "engine (paged KV cache) instead of generate_kv; "
+                        "each prompt becomes one request, sampled from its "
+                        "own per-request stream (seed + row index)")
+    p.add_argument("--serve_batch", type=int, default=8,
+                   help="serving engine slot batch (with --serve)")
+    p.add_argument("--serve_block_size", type=int, default=16,
+                   help="paged KV cache block size (with --serve)")
     p.add_argument("--mesh_data", type=int, default=1,
                    help="shard batch rows over a data mesh axis")
     p.add_argument("--mesh_tensor", type=int, default=1,
@@ -118,6 +127,38 @@ def main(argv=None) -> int:
     if prompt_lens is not None and not use_kv:
         p.error("ragged multi-prompt decode needs the KV path: shorten "
                 "--max_new_tokens to fit max_seq_len, or drop --no_kv_cache")
+
+    if args.serve:
+        # Serving-engine escape hatch: same checkpoint/tokenizer plumbing,
+        # but each prompt is an independent request with its own sampling
+        # stream (seed = --seed + row). temperature 0 reproduces
+        # generate_kv's greedy output exactly; stochastic draws come from
+        # per-request streams, so they differ from the shared-rng batch
+        # sampler by construction.
+        if args.no_kv_cache:
+            p.error("--serve is the paged KV path; drop --no_kv_cache")
+        if args.mesh_data * args.mesh_tensor > 1:
+            p.error("--serve does not compose with mesh sharding yet")
+        if not fits:
+            p.error("prompt + --max_new_tokens exceeds max_seq_len")
+        from tpu_trainer.serving import Request, SamplingParams, ServingEngine
+
+        engine = ServingEngine(
+            params, config,
+            max_batch=min(len(rows), args.serve_batch),
+            block_size=args.serve_block_size,
+        )
+        reqs = [
+            Request(rid=i, prompt=list(r),
+                    max_new_tokens=args.max_new_tokens,
+                    sampling=SamplingParams(temperature=args.temperature,
+                                            top_k=args.top_k,
+                                            seed=args.seed + i))
+            for i, r in enumerate(rows)
+        ]
+        for r in engine.run(reqs, time_mode="steps"):
+            print(tokenizer.decode(r.prompt + r.generated))
+        return 0
 
     n_shards = args.mesh_data * args.mesh_tensor
     if n_shards > 1 and not use_kv:

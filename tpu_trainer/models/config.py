@@ -180,6 +180,31 @@ class GPTConfig:
     # kernels along exactly the axis the fusion concatenates.
     fused_projections: bool = True
 
+    # --- Paged decode (the serving engine's cache layout) ---------------
+    # Static switch: route _decode_attention through the PAGED KV cache —
+    # fixed-size blocks in a preallocated pool addressed by per-row block
+    # tables (vLLM-style PagedAttention; tpu_trainer/serving/). Set by
+    # ServingEngine via dataclasses.replace; mutually exclusive with
+    # decode_ragged (the contiguous ragged path). Not a training knob.
+    decode_paged: bool = False
+    # Pool geometry, static so the cache variables (and jit) specialize:
+    # tokens per block / total blocks in the pool (block 0 reserved as the
+    # null block writes of masked rows land in) / block-table width =
+    # per-request capacity ceiling in blocks.
+    paged_block_size: int = 16
+    paged_num_blocks: int = 0
+    paged_max_blocks: int = 0
+    # Store the paged pools as blockwise-absmax int8 (utils/quant.py —
+    # the optimizer-state scheme pointed at the KV cache): halves-to-
+    # quarters pool HBM, ~1e-2 relative error on the attention output
+    # (documented tolerance; greedy streams may diverge where logits are
+    # near-tied).
+    paged_kv_int8: bool = False
+    # Decode-attention implementation over the pool: "reference" (pure
+    # jnp gather — the CPU path), "kernel" (Pallas flash-decode,
+    # interpret off-TPU), "auto" = kernel on TPU, reference elsewhere.
+    paged_attention: str = "auto"
+
     # Static switch for the ragged (per-row prompt length) KV-decode path:
     # set internally by generate_kv(prompt_lens=...); uniform decode keeps
     # the cheaper shared-position attention. Not a training knob.
@@ -246,6 +271,21 @@ class GPTConfig:
                 f"pipeline_virtual_stages >= 2 "
                 f"(got {self.pipeline_virtual_stages}); v=1 is plain 1f1b"
             )
+        if self.paged_attention not in ("auto", "reference", "kernel"):
+            raise ValueError(
+                f"unknown paged_attention {self.paged_attention!r}; "
+                f"choose auto, reference, or kernel"
+            )
+        if self.decode_paged:
+            if self.decode_ragged:
+                raise ValueError(
+                    "decode_paged and decode_ragged are mutually exclusive"
+                )
+            if self.paged_num_blocks < 2 or self.paged_max_blocks < 1:
+                raise ValueError(
+                    "decode_paged needs paged_num_blocks >= 2 (block 0 is "
+                    "the reserved null block) and paged_max_blocks >= 1"
+                )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "

@@ -262,6 +262,8 @@ class CausalSelfAttention(nn.Module):
         positions ``idx..idx+s-1``.
         """
         cfg = self.config
+        if cfg.decode_paged:
+            return self._paged_decode_attention(q, k, v)
         b, s, h, d = q.shape
         kvh = k.shape[2]  # num_kv_heads: the GQA cache is group-fold smaller
         # Cache length: the static decode window when set (generate_kv
@@ -346,6 +348,142 @@ class CausalSelfAttention(nn.Module):
             scores.astype(jnp.float32), axis=-1
         ).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", weights, v_all)
+
+    def _paged_decode_attention(self, q, k, v) -> jax.Array:
+        """KV-cached attention over a PAGED cache (``cfg.decode_paged``).
+
+        Instead of one contiguous ``[b, max_len, ...]`` buffer per row, KV
+        history lives in fixed-size blocks inside a shared pool
+        (``[num_blocks, block_size, kvh, d]``), addressed through per-row
+        block tables — the serving engine allocates/frees blocks from a
+        free list so memory scales with tokens actually cached, not with
+        slots * context limit (tpu_trainer/serving/paged_cache.py).
+
+        Cache-variable contract (the engine writes ``tables``/``lengths``
+        from its host-side state before every call):
+
+        - prefill (``s > 1``): rows start empty; ``lengths[r]`` is row
+          r's TRUE token count within the right-padded width (attention
+          masks beyond it; padded positions scatter into the null block
+          0). Attention runs over this call's in-flight k/v — the pool is
+          written, not read. ``lengths`` is left as-is (it already counts
+          the tokens being deposited).
+        - decode (``s == 1``): the new token writes at position
+          ``lengths[r]`` of row r's table and attends over ``lengths[r]
+          + 1`` pooled positions (flash_decode kernel or the jnp
+          reference, ``cfg.paged_attention``); ``lengths`` increments.
+        """
+        cfg = self.config
+        b, s, h, d = q.shape
+        kvh = k.shape[2]
+        bsz = cfg.paged_block_size
+        nblk = cfg.paged_num_blocks
+        mb = cfg.paged_max_blocks
+        int8 = cfg.paged_kv_int8
+        from tpu_trainer.utils.quant import quant_block_len, quantize_kv_int8
+
+        nbq = d // quant_block_len(d)
+        kv_dtype = jnp.int8 if int8 else cfg.compute_dtype
+        pk = self.variable(
+            "cache", "pool_k", jnp.zeros, (nblk, bsz, kvh, d), kv_dtype)
+        pv = self.variable(
+            "cache", "pool_v", jnp.zeros, (nblk, bsz, kvh, d), kv_dtype)
+        if int8:
+            sk = self.variable(
+                "cache", "scale_k", jnp.zeros, (nblk, bsz, kvh, nbq),
+                jnp.float32)
+            sv = self.variable(
+                "cache", "scale_v", jnp.zeros, (nblk, bsz, kvh, nbq),
+                jnp.float32)
+        tb = self.variable("cache", "tables", jnp.zeros, (b, mb), jnp.int32)
+        ln = self.variable("cache", "lengths", jnp.zeros, (b,), jnp.int32)
+        tables, lengths = tb.value, ln.value
+
+        cos, sin = rope_tables(mb * bsz, d, cfg.rope_theta)
+        if s == 1:
+            pos = lengths[:, None]                               # [b, 1]
+        else:
+            pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        q, k = apply_rotary_pos_emb(q, k, cos[pos], sin[pos])
+
+        # Scatter this call's k/v into the pool: position p of row r lands
+        # at (tables[r, p // bsz], p % bsz). Prefill padding (p >= the
+        # row's true length) redirects to the reserved null block 0 —
+        # written garbage there is never read (every read masks by
+        # lengths), so a [b*s] flat scatter needs no predication.
+        write_pos = pos
+        valid = (write_pos < lengths[:, None]) if s > 1 else (
+            jnp.ones((b, 1), bool))
+        blk_ids = jnp.take_along_axis(
+            tables, jnp.minimum(write_pos // bsz, mb - 1), axis=1)
+        blk_ids = jnp.where(valid, blk_ids, 0).reshape(-1)
+        offs = jnp.where(valid, write_pos % bsz, 0).reshape(-1)
+        if int8:
+            k_q, k_s = quantize_kv_int8(k)
+            v_q, v_s = quantize_kv_int8(v)
+            pool_k = pk.value.at[blk_ids, offs].set(
+                k_q.reshape(b * s, kvh, d))
+            pool_v = pv.value.at[blk_ids, offs].set(
+                v_q.reshape(b * s, kvh, d))
+            scale_k = sk.value.at[blk_ids, offs].set(
+                k_s.reshape(b * s, kvh, nbq))
+            scale_v = sv.value.at[blk_ids, offs].set(
+                v_s.reshape(b * s, kvh, nbq))
+        else:
+            pool_k = pk.value.at[blk_ids, offs].set(
+                k.astype(kv_dtype).reshape(b * s, kvh, d))
+            pool_v = pv.value.at[blk_ids, offs].set(
+                v.astype(kv_dtype).reshape(b * s, kvh, d))
+            scale_k = scale_v = None
+
+        if s > 1:
+            # Prefill attention runs over the in-flight k/v directly
+            # (everything attendable was just computed): ragged causal,
+            # keeping each pad query's self position so its (never-read)
+            # softmax row stays finite — same rationale as the contiguous
+            # ragged path above.
+            kf, vf = k, v
+            if kvh != h:
+                from tpu_trainer.ops.attention import repeat_kv
+
+                kf, vf = repeat_kv(kf, vf, h)
+            scale = 1.0 / (d ** 0.5)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * scale
+            q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            allowed = (k_pos[None] <= q_pos[None]) & (
+                (k_pos[None] < lengths[:, None, None])
+                | (k_pos[None] == q_pos[None])
+            )
+            scores = jnp.where(
+                allowed[:, None], scores, jnp.finfo(scores.dtype).min)
+            weights = jax.nn.softmax(
+                scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", weights, vf)
+            new_len = lengths
+        else:
+            from tpu_trainer.ops import flash as flash_lib
+
+            new_len = lengths + 1
+            impl = cfg.paged_attention
+            if impl == "auto":
+                impl = ("kernel" if jax.default_backend() == "tpu"
+                        else "reference")
+            fn = (flash_lib.flash_decode if impl == "kernel"
+                  else flash_lib.paged_attention_reference)
+            out = fn(
+                q[:, 0], pool_k, pool_v, tables, new_len,
+                k_scale=scale_k, v_scale=scale_v,
+            ).astype(q.dtype)[:, None]                    # [b, 1, h, d]
+
+        if not self.is_initializing():
+            pk.value = pool_k
+            pv.value = pool_v
+            if int8:
+                sk.value = scale_k
+                sv.value = scale_v
+            ln.value = new_len
+        return out
 
 
 def _residual_dropout(cfg, module, x, deterministic):
@@ -829,8 +967,25 @@ def init_cache(config: GPTConfig, batch_size: int):
     )
 
 
+def init_paged_cache(config: GPTConfig, batch_size: int):
+    """Zero-initialized PAGED cache pytree (``config.decode_paged``): the
+    block pools, per-row block tables, and lengths every layer's
+    ``_paged_decode_attention`` reads. The serving engine overwrites the
+    ``tables``/``lengths`` leaves from its host-side scheduler state
+    before each jitted step (serving/engine.py)."""
+    if not config.decode_paged:
+        raise ValueError("init_paged_cache needs config.decode_paged=True")
+    return init_cache(config, batch_size)
+
+
 def _sample(logits, rng, temperature: float, top_k: int):
-    """Temperature + top-k categorical sampling (reference gpt.py:473-482)."""
+    """Temperature + top-k categorical sampling (reference gpt.py:473-482).
+
+    ``temperature == 0`` is exact greedy argmax (temperature is static
+    under jit, so this is a trace-time branch) — it used to divide by
+    zero and sample NaN logits."""
+    if temperature == 0:
+        return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][:, -1:]

@@ -1342,3 +1342,248 @@ def flash_attention(
         o3, lse = out
         return o3.reshape(b, s, h_k, d)[:, :, :h], lse[:, :h]
     return out.reshape(b, s, h_k, d)[:, :, :h]
+
+
+# --- flash-decode: single-query attention over a PAGED KV cache -------------
+#
+# The serving engine's decode step (tpu_trainer/serving/): each request's
+# KV history lives in fixed-size blocks scattered through a preallocated
+# pool, addressed by a per-request block table. The kernel is the
+# split-KV sibling of the split dkv/dq backward above — grid
+# ``(batch, heads, n_splits, blocks_per_split)`` where each (batch, head,
+# split) program walks its share of the request's cache blocks with an
+# online softmax in VMEM scratch and flushes a partial (m, l, acc)
+# triple; the per-split partials merge in plain jnp (the standard
+# flash-decoding recombination: ``o = sum_s exp(m_s - m*) acc_s /
+# sum_s exp(m_s - m*) l_s``). The block gather rides the BlockSpec index
+# maps via scalar prefetch: the block table and lengths are
+# ``num_scalar_prefetch`` operands, so ``tables[b, split*bps + j]``
+# *indexes the k/v pool block to DMA* — the gather costs nothing beyond
+# the reads the attention needed anyway.
+#
+# An int8 cache mode dequantizes gathered blocks in VMEM: the pools carry
+# ``int8 [nblk, bs, kvh, d]`` plus blockwise absmax scales
+# ``f32 [nblk, bs, kvh, d // quant_block_len(d)]`` (utils/quant.py — the
+# same scheme as the quantized optimizer state).
+#
+# ``paged_attention_reference`` is the pure-jnp path: identical math via
+# a full-table gather, used as the CPU serving path and the parity oracle
+# tier-1 pins the kernel against (interpret=True).
+
+
+def _decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, *rest,
+                   block_size, bps, int8, nbq):
+    """One (batch row, head, split) program; grid dim 3 walks the split's
+    cache blocks sequentially with (m, l, acc) online-softmax state in
+    VMEM scratch."""
+    if int8:
+        ks_ref, vs_ref, m_ref, l_ref, acc_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_ref, l_ref, acc_ref, m_scr, l_scr, acc_scr = rest
+    ib = pl.program_id(0)
+    isp = pl.program_id(2)
+    jb = pl.program_id(3)
+    d = q_ref.shape[2]
+
+    @pl.when(jb == 0)
+    def _zero():
+        m_scr[...] = jnp.full((1, 1), _NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros((1, 1), jnp.float32)
+        acc_scr[...] = jnp.zeros((1, d), jnp.float32)
+
+    length = lengths_ref[ib]
+    start = (isp * bps + jb) * block_size
+
+    # Static body, predicated off for blocks wholly past this row's length
+    # (same structure as the causal block skip in the training kernels).
+    @pl.when(start < length)
+    def _body():
+        q = q_ref[0]                                    # [1, d] (pre-scaled)
+        if int8:
+            blkq = d // nbq
+            k = (k_ref[0].astype(jnp.float32)
+                 .reshape(block_size, nbq, blkq)
+                 * ks_ref[0][:, :, None]).reshape(block_size, d)
+            v = (v_ref[0].astype(jnp.float32)
+                 .reshape(block_size, nbq, blkq)
+                 * vs_ref[0][:, :, None]).reshape(block_size, d)
+        else:
+            k = k_ref[0]                                # [block_size, d]
+            v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_old = m_scr[...]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_old - m_new)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(jb == pl.num_programs(3) - 1)
+    def _flush():
+        m_ref[0, 0, 0, 0] = m_scr[0, 0]
+        l_ref[0, 0, 0, 0] = l_scr[0, 0]
+        acc_ref[0, 0, 0, :] = acc_scr[0, :]
+
+
+def _auto_splits(max_blocks: int) -> int:
+    """Largest divisor of the table width <= 4 (the split-KV parallelism
+    knob; mb must split evenly so every program walks a static count)."""
+    for ns in (4, 3, 2):
+        if max_blocks % ns == 0:
+            return ns
+    return 1
+
+
+def flash_decode(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    n_splits: int = 0,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Single-query attention over a paged KV cache (flash-decoding).
+
+    - ``q``: ``[batch, heads, head_dim]`` — ONE query token per row.
+    - ``pool_k/pool_v``: ``[num_blocks, block_size, kv_heads, head_dim]``
+      block pool (float; or int8 with ``k_scale``/``v_scale``
+      ``[num_blocks, block_size, kv_heads, d // quant_block_len(d)]``).
+    - ``tables``: ``[batch, max_blocks]`` int32 — block ids per row, in
+      position order; entries past a row's allocation should point at the
+      reserved null block 0.
+    - ``lengths``: ``[batch]`` int32 — valid tokens per row, INCLUDING the
+      current one (so >= 1 for live rows; a length-0 row yields NaN).
+
+    Returns f32 ``[batch, heads, head_dim]``. GQA: query head ``ih`` reads
+    kv head ``ih // (heads // kv_heads)``. Compiled-mode tiling needs
+    ``head_dim`` lane-compatible (64/128-multiples); interpret mode (the
+    CPU serving and tier-1 path) has no constraint.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    nblk, bsz, kvh, dk = pool_k.shape
+    assert dk == d and h % kvh == 0, (q.shape, pool_k.shape)
+    group = h // kvh
+    mb = tables.shape[1]
+    int8 = pool_k.dtype == jnp.int8
+    if int8 and (k_scale is None or v_scale is None):
+        raise ValueError("int8 pools need k_scale/v_scale")
+    nbq = k_scale.shape[-1] if int8 else 1
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not n_splits:
+        n_splits = _auto_splits(mb)
+    if mb % n_splits != 0:
+        raise ValueError(f"max_blocks {mb} % n_splits {n_splits} != 0")
+    bps = mb // n_splits
+
+    qf = (q.astype(jnp.float32) * (1.0 / math.sqrt(d)))
+    # Folded pool layouts so the BlockSpecs slice per kv head on the last
+    # dim (same no-transpose trick as the training kernels' [b, s, h*d]).
+    k3 = pool_k.reshape(nblk, bsz, kvh * d)
+    v3 = pool_v.reshape(nblk, bsz, kvh * d)
+
+    def _blk(width, col_scale):
+        return pl.BlockSpec(
+            (1, bsz, width),
+            lambda ib, ih, isp, jb, tr, lr, _w=width, _c=col_scale:
+            (tr[ib, isp * bps + jb], 0, ih // group),
+        )
+
+    in_specs = [
+        pl.BlockSpec((1, 1, d), lambda ib, ih, isp, jb, tr, lr: (ib, ih, 0)),
+        _blk(d, 1),
+        _blk(d, 1),
+    ]
+    ops = [qf, k3, v3]
+    if int8:
+        in_specs += [_blk(nbq, 1), _blk(nbq, 1)]
+        ops += [k_scale.reshape(nblk, bsz, kvh * nbq),
+                v_scale.reshape(nblk, bsz, kvh * nbq)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, n_splits, bps),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, 1, 1),
+                         lambda ib, ih, isp, jb, tr, lr: (ib, ih, 0, isp)),
+            pl.BlockSpec((1, 1, 1, 1),
+                         lambda ib, ih, isp, jb, tr, lr: (ib, ih, 0, isp)),
+            pl.BlockSpec((1, 1, 1, d),
+                         lambda ib, ih, isp, jb, tr, lr: (ib, ih, isp, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+        ],
+    )
+    m, l, acc = pl.pallas_call(
+        functools.partial(_decode_kernel, block_size=bsz, bps=bps,
+                          int8=int8, nbq=nbq),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, 1, n_splits), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, n_splits), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_splits, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(tables, lengths, *ops)
+    # Split merge: renormalize each split's accumulator by the global max
+    # and combine (empty splits carry m = -inf -> weight exp(-inf) = 0).
+    m_star = jnp.max(m, axis=-1, keepdims=True)              # [b, h, 1, 1]
+    w = jnp.exp(m - m_star)[:, :, 0, :]                      # [b, h, S]
+    l_tot = jnp.sum(l[:, :, 0, :] * w, axis=-1)              # [b, h]
+    return jnp.einsum("bhs,bhsd->bhd", w, acc) / l_tot[:, :, None]
+
+
+def paged_attention_reference(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pure-jnp ``flash_decode``: gather the whole table view, mask past
+    each row's length, plain f32 softmax. Same operands/result contract.
+    The CPU serving path (a [b, mb*bsz] gather beats an interpreted grid
+    walk by orders of magnitude) and the oracle the kernel tests pin
+    against."""
+    b, h, d = q.shape
+    nblk, bsz, kvh, _ = pool_k.shape
+    group = h // kvh
+    mb = tables.shape[1]
+    if pool_k.dtype == jnp.int8:
+        nbq = k_scale.shape[-1]
+        blkq = d // nbq
+        deq = lambda p, s: (  # noqa: E731
+            p.astype(jnp.float32).reshape(nblk, bsz, kvh, nbq, blkq)
+            * s[..., None]).reshape(nblk, bsz, kvh, d)
+        pool_k = deq(pool_k, k_scale)
+        pool_v = deq(pool_v, v_scale)
+    k = pool_k[tables].reshape(b, mb * bsz, kvh, d).astype(jnp.float32)
+    v = pool_v[tables].reshape(b, mb * bsz, kvh, d).astype(jnp.float32)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32), k)
+    s = s * (1.0 / math.sqrt(d))
+    pos = jnp.arange(mb * bsz)[None, None]
+    s = jnp.where(pos < lengths[:, None, None], s, _NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", w, v)

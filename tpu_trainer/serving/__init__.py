@@ -1,0 +1,29 @@
+"""Serving engine: continuous batching over a paged KV cache.
+
+The inference-side counterpart of the training stack (ROADMAP open item
+1): ``eval/infer.py`` drives one contiguous-cache ``generate_kv`` call
+per batch; this package turns the same model into an engine that serves
+a *request stream* —
+
+- ``paged_cache``  — vLLM-style block pool: KV memory in fixed-size
+  blocks with per-request block tables and free-list reclaim, so cache
+  HBM scales with tokens actually held, not slots * context limit.
+- ``scheduler``    — Orca-style iteration-level scheduling: admission by
+  free-block budget, prefill/decode interleaving, EOS/max-token
+  retirement, recompute-preemption when the pool runs dry.
+- ``sampling``     — batched per-request sampling (temperature / top-k /
+  seed), deterministic per (seed, token index) so preempted requests
+  resume with identical continuations.
+- ``engine``       — the front-end: jitted prefill/decode steps over the
+  paged model path (``GPTConfig.decode_paged``), latency/throughput
+  stats, and a ``python -m tpu_trainer.serving.engine`` CLI replaying a
+  seeded open-loop Poisson arrival trace.
+"""
+
+from tpu_trainer.serving.engine import ServingEngine, poisson_trace  # noqa: F401
+from tpu_trainer.serving.paged_cache import BlockPool, PagedKVCache  # noqa: F401
+from tpu_trainer.serving.scheduler import (  # noqa: F401
+    Request,
+    SamplingParams,
+    Scheduler,
+)

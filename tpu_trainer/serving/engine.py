@@ -1,0 +1,415 @@
+"""Serving engine: jitted prefill/decode steps over the paged model path.
+
+The engine owns a fixed slot batch (``max_batch`` rows). Every iteration
+the scheduler picks ONE of:
+
+- **prefill** — the requests admitted this iteration run a full forward
+  over prompt + generated-so-far (width bucketed to a power of two so
+  nearby shapes share a compile). Feeding generated tokens too is what
+  makes recompute-preemption exact: a resumed request is
+  indistinguishable from one that was never interrupted — same cache
+  contents, same next sampling step.
+- **decode** — every running request advances one token in a single
+  ``[slots, 1]`` forward.
+
+Both steps are one jitted dispatch including sampling (per-request
+temperature / top-k / seed, ``serving/sampling.py``). The only
+persistent device state is the KV block pools; block tables and lengths
+are re-broadcast from the scheduler's host mirrors into the cache pytree
+*inside* the jit, so scheduling never syncs the device. Idle and
+non-prefilled rows have zeroed table rows and length 0: their writes
+land in reserved block 0 and their sampled tokens are ignored host-side,
+which keeps every step unpredicated over the full slot batch.
+
+``python -m tpu_trainer.serving.engine`` replays a seeded open-loop
+Poisson arrival trace against a synthetic checkpoint and prints the
+latency/throughput summary (see also benchmarks/serve_bench.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT, init_paged_cache
+from tpu_trainer.serving.paged_cache import PagedKVCache
+from tpu_trainer.serving.sampling import sample_tokens
+from tpu_trainer.serving.scheduler import Request, SamplingParams, Scheduler
+
+
+def _bucket_pow2(n: int, lo: int = 8) -> int:
+    w = lo
+    while w < n:
+        w *= 2
+    return w
+
+
+class ServingEngine:
+    """Continuous-batching engine over one model + parameter set."""
+
+    def __init__(
+        self,
+        params,
+        config: GPTConfig,
+        *,
+        max_batch: int = 8,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        max_blocks_per_request: Optional[int] = None,
+        kv_int8: bool = False,
+        attention: str = "auto",
+        eos_id: Optional[int] = None,
+        watermark_blocks: int = 0,
+        clock=time.perf_counter,
+    ):
+        if max_blocks_per_request is None:
+            max_blocks_per_request = -(-config.max_seq_len // block_size)
+        if num_blocks is None:
+            # Enough for every slot to run at full context, + null block.
+            num_blocks = max_batch * max_blocks_per_request + 1
+        self.config = dataclasses.replace(
+            config,
+            dropout=0.0,
+            attention_dropout=0.0,
+            decode_paged=True,
+            decode_ragged=False,
+            paged_block_size=block_size,
+            paged_num_blocks=num_blocks,
+            paged_max_blocks=max_blocks_per_request,
+            paged_kv_int8=kv_int8,
+            paged_attention=attention,
+        )
+        self.params = params
+        self.max_batch = max_batch
+        self.eos_id = eos_id
+        self.clock = clock
+        self.cache_state = PagedKVCache(self.config, max_batch)
+        self.scheduler = Scheduler(
+            self.cache_state, watermark_blocks=watermark_blocks
+        )
+        self.device_cache = init_paged_cache(self.config, max_batch)
+        self._model = GPT(self.config)
+        self._step_jit = jax.jit(
+            functools.partial(_engine_step, self._model),
+            static_argnames=("k_cap", "prefill"),
+        )
+        self._k_cap = 1
+        self._iters = 0
+        self._t0 = None
+        self.stats: Dict[str, float] = {
+            "prefill_iters": 0, "decode_iters": 0, "idle_iters": 0,
+            "prefill_tokens": 0, "generated_tokens": 0,
+            "occupancy_sum": 0.0, "occupancy_samples": 0,
+            "occupancy_max": 0.0,
+        }
+
+    def reset_stats(self) -> None:
+        """Zero counters/clock between a warm-up run and a timed run. The
+        engine must be drained (no waiting/running requests); the device
+        pools keep stale KV but lengths masking means it is never read."""
+        assert not self.scheduler.has_work(), "reset_stats on a busy engine"
+        self._iters = 0
+        self._t0 = None
+        self.scheduler.n_preemptions = 0
+        self.wall_elapsed = 0.0
+        for k in self.stats:
+            self.stats[k] = 0.0 if isinstance(self.stats[k], float) else 0
+
+    # -- one engine iteration ----------------------------------------------
+
+    def step(self) -> List[Request]:
+        """Run one scheduler iteration. Returns requests finished now."""
+        self._iters += 1
+        kind, reqs = self.scheduler.schedule()
+        if kind == "idle":
+            self.stats["idle_iters"] += 1
+            return []
+        if kind == "prefill":
+            finished = self._forward(reqs, prefill=True)
+            self.stats["prefill_iters"] += 1
+        else:
+            reqs = self.scheduler.ensure_decode_blocks()
+            if not reqs:          # everything preempted itself back out
+                return []
+            finished = self._forward(reqs, prefill=False)
+            self.stats["decode_iters"] += 1
+        occ = self.cache_state.pool.occupancy
+        self.stats["occupancy_sum"] += occ
+        self.stats["occupancy_samples"] += 1
+        self.stats["occupancy_max"] = max(self.stats["occupancy_max"], occ)
+        return finished
+
+    def _forward(self, reqs: List[Request], *, prefill: bool) -> List[Request]:
+        slots = self.max_batch
+        cs = self.cache_state
+        if prefill:
+            width = _bucket_pow2(max(r.context_len() for r in reqs))
+            width = min(width, cs.capacity_tokens())
+            ids = np.zeros((slots, width), np.int32)
+            # Only the prefilled rows carry real tables: running requests'
+            # rows are nulled so this pass cannot touch their blocks.
+            tables = np.zeros_like(cs.tables)
+            lengths = np.zeros((slots,), np.int32)
+            for r in reqs:
+                seq = r.prompt + r.generated
+                ids[r.slot, : len(seq)] = seq
+                tables[r.slot] = cs.tables[r.slot]
+                lengths[r.slot] = len(seq)
+                self.stats["prefill_tokens"] += len(seq)
+        else:
+            ids = np.zeros((slots, 1), np.int32)
+            tables = cs.tables
+            lengths = np.zeros((slots,), np.int32)
+            for r in reqs:
+                ids[r.slot, 0] = (r.prompt + r.generated)[-1]
+                lengths[r.slot] = r.cached_tokens()
+        temps = np.zeros((slots,), np.float32)
+        topks = np.zeros((slots,), np.int32)
+        keys = np.zeros((slots, 2), np.uint32)
+        steps = np.zeros((slots,), np.int32)
+        for r in reqs:
+            temps[r.slot] = r.sampling.temperature
+            topks[r.slot] = r.sampling.top_k
+            keys[r.slot] = r.key()
+            steps[r.slot] = len(r.generated)   # index of the draw made now
+            if r.sampling.top_k > self._k_cap:
+                self._k_cap = r.sampling.top_k
+
+        self.device_cache, tokens = self._step_jit(
+            self.params, self.device_cache,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(ids),
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(keys),
+            jnp.asarray(steps), k_cap=self._k_cap, prefill=prefill,
+        )
+        tokens = np.asarray(tokens)
+
+        now = self._now()
+        finished: List[Request] = []
+        for r in reqs:
+            tok = int(tokens[r.slot])
+            r.generated.append(tok)
+            self.stats["generated_tokens"] += 1
+            # Cache now holds everything fed this pass (not the new token).
+            cs.lengths[r.slot] = r.context_len() - 1
+            if r.first_token_at is None:
+                r.first_token_at = now
+            if (r.eos_id is not None and tok == r.eos_id) or (
+                len(r.generated) >= r.max_new_tokens
+            ):
+                r.finished_at = now
+                self.scheduler.retire(r)
+                finished.append(r)
+        return finished
+
+    def _now(self) -> float:
+        if self._t0 is None:
+            self._t0 = self.clock()
+        return self.clock() - self._t0
+
+    # -- trace replay ------------------------------------------------------
+
+    def run(
+        self,
+        requests: Sequence[Request],
+        *,
+        time_mode: str = "wall",
+        max_iters: int = 10_000_000,
+    ) -> List[Request]:
+        """Replay an open-loop trace: each request joins the waiting queue
+        when the clock passes its ``arrival_time``. ``time_mode="wall"``
+        measures arrivals in seconds; ``"steps"`` measures them in engine
+        iterations — fully deterministic, for tests and replay checks.
+        Returns the finished requests in input order."""
+        if time_mode not in ("wall", "steps"):
+            raise ValueError(f"time_mode={time_mode!r}")
+        pending = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
+        pending = list(pending)
+        self._t0 = self.clock()
+        t_start = self._t0
+        done: List[Request] = []
+        while pending or self.scheduler.has_work():
+            now = (
+                float(self._iters) if time_mode == "steps" else self._now()
+            )
+            while pending and pending[0].arrival_time <= now:
+                self.scheduler.add(pending.pop(0))
+            if not self.scheduler.has_work():
+                if time_mode == "wall":
+                    time.sleep(
+                        min(1e-3, max(0.0, pending[0].arrival_time - now))
+                    )
+                else:
+                    self._iters += 1   # idle tick advances the step clock
+                continue
+            done.extend(self.step())
+            if self._iters >= max_iters:
+                raise RuntimeError(f"engine did not drain in {max_iters} iters")
+        self.wall_elapsed = self.clock() - t_start
+        by_rid = {r.rid: r for r in done}
+        return [by_rid[r.rid] for r in requests]
+
+    def summary(self) -> Dict[str, float]:
+        s = dict(self.stats)
+        n = max(1, int(s.pop("occupancy_samples")))
+        s["occupancy_mean"] = s.pop("occupancy_sum") / n
+        s["preemptions"] = self.scheduler.n_preemptions
+        s["iters"] = self._iters
+        if getattr(self, "wall_elapsed", 0):
+            s["wall_s"] = self.wall_elapsed
+            s["tokens_per_s"] = s["generated_tokens"] / self.wall_elapsed
+        return s
+
+
+def _engine_step(
+    model, params, cache, tables, lengths, ids,
+    temps, topks, keys, steps, *, k_cap: int, prefill: bool,
+) -> Tuple[dict, jax.Array]:
+    """One jitted engine step: broadcast host scheduling state into the
+    cache pytree, forward, gather each row's last real logit, sample."""
+
+    def put(path, x):
+        key = getattr(path[-1], "key", None)
+        if key == "tables":
+            return jnp.broadcast_to(tables, x.shape)
+        if key == "lengths":
+            return jnp.broadcast_to(lengths, x.shape)
+        return x
+
+    cache = jax.tree_util.tree_map_with_path(put, cache)
+    (logits, _), vars_out = model.apply(
+        {"params": params, "cache": cache}, ids, decode=True,
+        mutable=["cache"],
+    )
+    if prefill:
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+    else:
+        last = logits[:, 0]
+    tokens = sample_tokens(
+        last.astype(jnp.float32), temps, topks, keys, steps, k_cap=k_cap
+    )
+    return vars_out["cache"], tokens
+
+
+def poisson_trace(
+    n_requests: int,
+    *,
+    vocab_size: int,
+    rate: float = 8.0,
+    seed: int = 0,
+    prompt_len_range: Tuple[int, int] = (8, 64),
+    max_new_range: Tuple[int, int] = (8, 32),
+    temperature: float = 1.0,
+    top_k: int = 0,
+    eos_id: Optional[int] = None,
+) -> List[Request]:
+    """Synthetic open-loop trace: exponential inter-arrivals at ``rate``
+    requests per time unit, uniform prompt/output lengths, one sampling
+    seed per request — all from one ``seed``, so a trace is replayable
+    bit-for-bit."""
+    rs = np.random.RandomState(seed)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, size=n_requests))
+    out = []
+    for i in range(n_requests):
+        plen = int(rs.randint(prompt_len_range[0], prompt_len_range[1] + 1))
+        mnew = int(rs.randint(max_new_range[0], max_new_range[1] + 1))
+        prompt = rs.randint(1, vocab_size, size=plen).tolist()
+        out.append(Request(
+            rid=i,
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=mnew,
+            sampling=SamplingParams(
+                temperature=temperature, top_k=top_k,
+                seed=int(rs.randint(0, 2**31 - 1)),
+            ),
+            arrival_time=float(arrivals[i]),
+            eos_id=eos_id,
+        ))
+    return out
+
+
+def request_metrics(reqs: Sequence[Request]) -> Dict[str, List[float]]:
+    """Per-request latency series (same time axis the engine ran on):
+    TTFT = first token minus arrival; TPOT = mean inter-token time over
+    the remaining tokens."""
+    ttft, tpot = [], []
+    for r in reqs:
+        if r.first_token_at is None:
+            continue
+        ttft.append(r.first_token_at - r.arrival_time)
+        n_rest = len(r.generated) - 1
+        if n_rest > 0 and r.finished_at is not None:
+            tpot.append((r.finished_at - r.first_token_at) / n_rest)
+    return {"ttft": ttft, "tpot": tpot}
+
+
+def _main() -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        description="Replay a seeded Poisson trace through the serving "
+        "engine on a synthetic checkpoint."
+    )
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=8.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--num-blocks", type=int, default=0,
+                   help="KV pool blocks (0 = size for max_batch full contexts)")
+    p.add_argument("--kv-int8", action="store_true")
+    p.add_argument("--attention", default="auto",
+                   choices=("auto", "reference", "kernel"))
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--time-mode", default="wall", choices=("wall", "steps"))
+    p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--max-seq-len", type=int, default=256)
+    args = p.parse_args()
+
+    config = GPTConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        num_layers=args.layers, num_heads=args.heads,
+        max_seq_len=args.max_seq_len, dropout=0.0, attention_dropout=0.0,
+        dtype="float32", param_dtype="float32",
+    )
+    model = GPT(config)
+    params = model.init(
+        jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = ServingEngine(
+        params, config, max_batch=args.max_batch,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks or None,
+        kv_int8=args.kv_int8, attention=args.attention,
+    )
+    trace = poisson_trace(
+        args.requests, vocab_size=args.vocab, rate=args.rate,
+        seed=args.seed, temperature=args.temperature, top_k=args.top_k,
+    )
+    finished = engine.run(trace, time_mode=args.time_mode)
+    summary = engine.summary()
+    lat = request_metrics(finished)
+    for name, series in lat.items():
+        if series:
+            summary[f"{name}_p50"] = float(np.percentile(series, 50))
+            summary[f"{name}_p99"] = float(np.percentile(series, 99))
+    print(json.dumps({k: round(v, 6) if isinstance(v, float) else v
+                      for k, v in sorted(summary.items())}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,0 +1,116 @@
+"""Paged KV cache: a block pool with free-list allocation + host mirrors.
+
+The device side is the flax cache collection the paged decode path
+creates (``models/gpt.py _paged_decode_attention``): per-layer k/v pools
+``[num_blocks, block_size, kvh, head_dim]`` (fp or int8 + scales), block
+tables ``[slots, max_blocks]`` and lengths ``[slots]``. The pools are
+the only *persistent* device state — tables and lengths are re-broadcast
+from the host mirrors kept here before every jitted step, so all
+scheduling (allocation, reclaim, preemption) is plain deterministic
+Python with zero device syncs.
+
+Block 0 is reserved as the null block: unallocated table entries point
+at it, and the model's scatter redirects masked writes (prefill padding,
+idle slots) there. Reads always mask by length, so its garbage is never
+observed — this is what lets the scatter and the jitted step run
+unpredicated over the full slot batch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class BlockPool:
+    """Free-list allocator over ``num_blocks`` pool blocks (id 0 reserved).
+
+    LIFO free list with deterministic order: the same request sequence
+    always produces the same block ids — part of the engine's
+    deterministic-replay contract.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the null block)")
+        self.num_blocks = num_blocks
+        # pop() hands out ascending ids on a fresh pool.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_blocks / (self.num_blocks - 1)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, or None (untouched pool) if short."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids) -> None:
+        for bid in ids:
+            if not 0 < bid < self.num_blocks:
+                raise ValueError(f"freeing invalid block id {bid}")
+            if bid in self._free:
+                raise ValueError(f"double free of block {bid}")
+            self._free.append(bid)
+
+
+class PagedKVCache:
+    """Host mirrors (tables, lengths, pool) for one engine's slot batch."""
+
+    def __init__(self, config, slots: int):
+        if not config.decode_paged:
+            raise ValueError("PagedKVCache needs config.decode_paged=True")
+        self.config = config
+        self.slots = slots
+        self.block_size = config.paged_block_size
+        self.max_blocks = config.paged_max_blocks
+        self.pool = BlockPool(config.paged_num_blocks)
+        self.tables = np.zeros((slots, self.max_blocks), np.int32)
+        self.lengths = np.zeros((slots,), np.int32)
+        self._n_blocks = np.zeros((slots,), np.int32)  # allocated per slot
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens``."""
+        return -(-n_tokens // self.block_size)
+
+    def capacity_tokens(self) -> int:
+        """Per-request token ceiling (the table width)."""
+        return self.max_blocks * self.block_size
+
+    def assign(self, slot: int, block_ids: List[int]) -> None:
+        """Install a fresh allocation into an empty slot's table row."""
+        assert self._n_blocks[slot] == 0, f"slot {slot} not released"
+        n = len(block_ids)
+        assert n <= self.max_blocks
+        self.tables[slot, :n] = block_ids
+        self._n_blocks[slot] = n
+
+    def extend(self, slot: int, block_ids: List[int]) -> None:
+        n0 = int(self._n_blocks[slot])
+        n = len(block_ids)
+        assert n0 + n <= self.max_blocks, f"slot {slot} table overflow"
+        self.tables[slot, n0:n0 + n] = block_ids
+        self._n_blocks[slot] = n0 + n
+
+    def slot_blocks(self, slot: int) -> List[int]:
+        return [int(b) for b in self.tables[slot, :self._n_blocks[slot]]]
+
+    def release(self, slot: int) -> None:
+        """Return a slot's blocks to the pool and null its table row."""
+        self.pool.free(self.slot_blocks(slot))
+        self.tables[slot] = 0
+        self.lengths[slot] = 0
+        self._n_blocks[slot] = 0

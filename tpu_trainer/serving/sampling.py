@@ -1,0 +1,60 @@
+"""Batched per-request sampling for the serving engine.
+
+``generate_kv`` samples one shared (temperature, top_k) per call;
+continuous batching puts requests with *different* sampling params in
+one decode row-batch. This module samples the whole batch in one jitted
+op with per-row temperature / top-k / PRNG key, and keys every draw by
+``fold_in(request_key, token_index)`` — the stream for a request depends
+only on its own seed and position, NOT on which other requests share the
+batch or how scheduling interleaved them. That independence is what
+makes preemption recompute-safe (a resumed request re-derives the exact
+draws it would have made) and replay deterministic.
+
+``temperature == 0`` rows take exact greedy argmax (the same contract as
+the fixed ``models/gpt.py _sample``), here as a data-dependent select
+since temperature is a traced per-row array.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k_cap",))
+def sample_tokens(
+    logits: jax.Array,      # [b, vocab] f32
+    temps: jax.Array,       # [b] f32; 0 = greedy
+    top_ks: jax.Array,      # [b] int32; 0 = no top-k filter
+    key_data: jax.Array,    # [b, 2] uint32 per-request PRNG keys
+    steps: jax.Array,       # [b] int32 token index within each request
+    *,
+    k_cap: int,
+) -> jax.Array:
+    """One token id per row. ``k_cap`` (static) bounds every row's top_k:
+    one ``lax.top_k(logits, k_cap)`` serves all rows, each masking at its
+    own kth value. The engine derives k_cap from the requests it admits
+    and recompiles only when a larger cap first appears."""
+    b, vocab = logits.shape
+    k_cap = max(1, min(k_cap, vocab))
+    vals = jax.lax.top_k(logits, k_cap)[0]                 # [b, k_cap] desc
+    k = jnp.clip(top_ks, 0, k_cap)
+    kth = jnp.take_along_axis(
+        vals, jnp.maximum(k - 1, 0)[:, None], axis=1)      # [b, 1]
+    filtered = jnp.where(
+        (k > 0)[:, None] & (logits < kth), -jnp.inf, logits)
+    scaled = filtered / jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.vmap(
+        lambda kd, st, lg: jax.random.categorical(
+            jax.random.fold_in(kd, st), lg)
+    )(key_data, steps, scaled)
+    return jnp.where(temps > 0, sampled, jnp.argmax(logits, axis=-1))
+
+
+def request_key(seed: int):
+    """The per-request key the engine stores host-side ([2] uint32)."""
+    import numpy as np
+
+    return np.asarray(jax.random.PRNGKey(seed))

@@ -1,0 +1,194 @@
+"""Continuous-batching scheduler (Orca-style iteration-level scheduling).
+
+Decisions happen per *iteration*, not per request-batch: every engine
+step is either ONE prefill over the requests admitted this iteration or
+ONE single-token decode over everything running — finished requests
+retire and release blocks immediately, and a newly admitted request
+joins the very next decode batch instead of waiting for the oldest
+request in flight to drain (the static-batching failure mode).
+
+Policies, all deterministic host-side Python over ``PagedKVCache``'s
+mirrors (no device syncs):
+
+- **Admission** (FIFO, by free-block budget): the head of the waiting
+  queue is admitted when a slot is free and the pool covers the blocks
+  its current context needs plus ``watermark_blocks``. Head-of-line
+  blocking is deliberate — arrival order is completion-fairness here.
+- **Decode growth**: a running request crossing a block boundary
+  allocates one block just-in-time.
+- **Preemption** (recompute-style, when the pool runs dry): the
+  latest-admitted running request frees everything and goes back to the
+  FRONT of the waiting queue; on re-admission it re-prefills prompt +
+  generated-so-far in one pass. Sampling is keyed by (seed, token
+  index) — serving/sampling.py — so the resumed continuation is
+  token-identical to the uninterrupted one.
+- **Retirement**: EOS or max_new_tokens; blocks return to the free list
+  the same iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+from tpu_trainer.serving.paged_cache import PagedKVCache
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling knobs (``temperature == 0`` = exact greedy)."""
+
+    temperature: float = 1.0
+    top_k: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request plus its scheduler/engine runtime state."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    arrival_time: float = 0.0
+    eos_id: Optional[int] = None
+
+    # Runtime state (engine/scheduler-owned).
+    generated: List[int] = dataclasses.field(default_factory=list)
+    status: str = "waiting"            # waiting | running | finished
+    slot: Optional[int] = None
+    preemptions: int = 0
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    _key = None                        # lazily built [2] uint32 PRNG key
+
+    def context_len(self) -> int:
+        """Tokens fed to the model so far (prompt + sampled)."""
+        return len(self.prompt) + len(self.generated)
+
+    def cached_tokens(self) -> int:
+        """Tokens whose K/V sit in the paged cache. The newest sampled
+        token is NOT cached yet — it is the next decode step's input."""
+        n = self.context_len()
+        return n - 1 if self.generated else n
+
+    def key(self):
+        if self._key is None:
+            from tpu_trainer.serving.sampling import request_key
+
+            self._key = request_key(self.sampling.seed)
+        return self._key
+
+
+class Scheduler:
+    """Iteration-level scheduler over one ``PagedKVCache`` slot batch."""
+
+    def __init__(self, cache: PagedKVCache, *, watermark_blocks: int = 0,
+                 max_prefill_rows: Optional[int] = None):
+        self.cache = cache
+        self.watermark = watermark_blocks
+        self.max_prefill_rows = max_prefill_rows or cache.slots
+        self.waiting: Deque[Request] = deque()
+        self.running: List[Request] = []   # admission order
+        self._free_slots = list(range(cache.slots))
+        self.n_preemptions = 0
+
+    # -- queue interface ---------------------------------------------------
+
+    def add(self, req: Request) -> None:
+        if not req.prompt:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        need = self.cache.blocks_for(len(req.prompt) + req.max_new_tokens)
+        if need > self.cache.max_blocks:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + max_new "
+                f"{req.max_new_tokens} needs {need} blocks > table width "
+                f"{self.cache.max_blocks}")
+        req.status = "waiting"
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- the per-iteration decision ---------------------------------------
+
+    def schedule(self) -> Tuple[str, List[Request]]:
+        """Decide this iteration: ``("prefill", admitted)`` when the head
+        of the queue fits the budget (prefill has priority — it is what
+        keeps slots full), else ``("decode", running)``, else
+        ``("idle", [])``."""
+        admitted: List[Request] = []
+        while (self.waiting and self._free_slots
+               and len(admitted) < self.max_prefill_rows):
+            req = self.waiting[0]
+            need = self.cache.blocks_for(req.context_len())
+            if need + self.watermark > self.cache.pool.free_blocks:
+                break
+            self.waiting.popleft()
+            blocks = self.cache.pool.alloc(need)
+            assert blocks is not None  # guarded by the free_blocks check
+            slot = self._free_slots.pop(0)
+            self.cache.assign(slot, blocks)
+            req.slot = slot
+            req.status = "running"
+            self.running.append(req)
+            admitted.append(req)
+        if admitted:
+            return "prefill", admitted
+        if self.running:
+            return "decode", list(self.running)
+        return "idle", []
+
+    def ensure_decode_blocks(self) -> List[Request]:
+        """Pre-decode block growth: every running request about to write
+        at a block boundary gets one block, preempting from the back of
+        the admission order when the pool is dry. Returns the requests
+        that actually decode this iteration (preemption victims drop
+        out — including, worst case, the requester itself)."""
+        stepped: List[Request] = []
+        for req in list(self.running):
+            if req.status != "running":
+                continue  # preempted as an earlier request's victim
+            pos = req.cached_tokens()
+            n_blocks = len(self.cache.slot_blocks(req.slot))
+            if pos == n_blocks * self.cache.block_size:
+                got = self._alloc_with_preemption(1, req)
+                if got is None:
+                    continue  # req itself was the last resort victim
+                self.cache.extend(req.slot, got)
+            stepped.append(req)
+        return stepped
+
+    def _alloc_with_preemption(self, n: int, requester: Request):
+        while True:
+            got = self.cache.pool.alloc(n)
+            if got is not None:
+                return got
+            victim = self.running[-1]
+            self.preempt(victim)
+            if victim is requester:
+                return None
+
+    # -- state transitions -------------------------------------------------
+
+    def preempt(self, victim: Request) -> None:
+        """Recompute-preemption: free everything, requeue at the FRONT so
+        re-admission preserves arrival order among the preempted."""
+        self._vacate(victim)
+        victim.status = "waiting"
+        victim.preemptions += 1
+        self.n_preemptions += 1
+        self.waiting.appendleft(victim)
+
+    def retire(self, req: Request) -> None:
+        self._vacate(req)
+        req.status = "finished"
+
+    def _vacate(self, req: Request) -> None:
+        self.cache.release(req.slot)
+        self._free_slots.append(req.slot)
+        self._free_slots.sort()
+        req.slot = None
+        self.running.remove(req)

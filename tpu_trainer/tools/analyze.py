@@ -7,9 +7,12 @@ Turns the stream a training run (or bench.py) emits —
 train/eval/goodput/telemetry/cost_analysis/comms_model/recompile/rollback
 records — into a human report: step-time percentiles, tok/s stability,
 the goodput table, spike/rollback/recompile events, and the comms share
-of the step. With ``--compare`` it renders PASS/FAIL verdicts for the new
-run against a baseline run on throughput, MFU, peak HBM, and final loss,
-and exits nonzero on any FAIL — a CI-usable gate over the bench
+of the step. ``serve`` records (benchmarks/serve_bench.py) and ``decode``
+records (benchmarks/decode_bench.py) fold into the same report, so one
+file can carry a whole train+serve CI run. With ``--compare`` it renders
+PASS/FAIL verdicts for the new run against a baseline run on throughput,
+MFU, peak HBM, final loss, serving tok/s and p99 tail latency, and
+decode-path tok/s, and exits nonzero on any FAIL — a CI-usable gate over the bench
 trajectory (exit 0 clean, 1 regression, 2 unreadable/mis-schema'd input).
 
 Every record must carry the ``schema_version`` stamp MetricLogger writes;
@@ -190,6 +193,30 @@ def summarize(records: List[dict]) -> dict:
             "restored_step": r.get("restored_step"),
         } for r in rollbacks]
 
+    serves = by_kind.get("serve", [])
+    if serves:
+        # serve_bench.py records: last one wins (a file may accumulate
+        # runs; the newest reflects the current tree).
+        s = serves[-1]
+        report["serve"] = {k: s.get(k) for k in (
+            "tokens_per_s", "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
+            "tpot_p99_s", "occupancy_mean", "occupancy_max", "preemptions",
+            "sequential_tokens_per_s", "concurrent_speedup", "n_requests",
+            "concurrency") if s.get(k) is not None}
+
+    decodes = by_kind.get("decode", [])
+    if decodes:
+        rows = decodes[-1].get("rows") or []
+        paths: Dict[str, float] = {}
+        for r in rows:
+            key = f"{r.get('path')}/bs{r.get('batch')}"
+            tps = r.get("tok_per_sec")
+            if tps is not None:
+                paths[key] = max(paths.get(key, 0.0), float(tps))
+        kv_best = max((v for k, v in paths.items() if k.startswith("kv/")),
+                      default=None)
+        report["decode"] = {"paths": paths, "kv_best_tok_per_sec": kv_best}
+
     telemetry_steps = [r.get("step") for r in train
                        if any(k.startswith("telemetry/") for k in r)]
     if telemetry_steps:
@@ -259,6 +286,26 @@ def render(report: dict) -> List[str]:
     for rb in report.get("rollbacks", []):
         lines.append(f"rollback at step {rb['step']} ({rb['cause']})"
                      f" -> restored step {rb['restored_step']}")
+    s = report.get("serve")
+    if s:
+        lines.append(
+            f"serve   {_fmt(s.get('tokens_per_s'), 0)} tok/s"
+            f" ({s.get('n_requests')} reqs @ {s.get('concurrency')})"
+            f" | TTFT p50 {_fmt((s.get('ttft_p50_s') or 0) * 1e3, 1)}ms"
+            f" p99 {_fmt((s.get('ttft_p99_s') or 0) * 1e3, 1)}ms"
+            f" | TPOT p50 {_fmt((s.get('tpot_p50_s') or 0) * 1e3, 1)}ms"
+            f" p99 {_fmt((s.get('tpot_p99_s') or 0) * 1e3, 1)}ms")
+        lines.append(
+            f"serve   occupancy mean {_fmt(s.get('occupancy_mean'))}"
+            f" max {_fmt(s.get('occupancy_max'))}"
+            f" | preemptions {s.get('preemptions')}"
+            + (f" | {_fmt(s.get('concurrent_speedup'))}x vs sequential"
+               if s.get("concurrent_speedup") is not None else ""))
+    d = report.get("decode")
+    if d:
+        tbl = "  ".join(f"{k} {_fmt(v, 0)}"
+                        for k, v in sorted(d["paths"].items()))
+        lines.append(f"decode  tok/s: {tbl}")
     return lines
 
 
@@ -266,7 +313,8 @@ def render(report: dict) -> List[str]:
 
 def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             mfu_tol: float = 0.10, mem_tol: float = 0.10,
-            loss_tol: float = 0.05, overhead_tol: float = 0.10) -> List[dict]:
+            loss_tol: float = 0.05, overhead_tol: float = 0.10,
+            serve_lat_tol: float = 0.25) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -292,6 +340,14 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
         ("mfu_p50", ("train", "mfu", "p50"), "higher", mfu_tol),
         ("peak_mem_gb", ("train", "peak_mem_gb"), "lower", mem_tol),
         ("final_loss", ("train", "final_loss"), "lower", loss_tol),
+        # Serving (serve_bench.py) and decode (decode_bench.py) records:
+        # throughput gates share tok_tol; latency gets the looser
+        # serve_lat_tol (tail latency is noisier than aggregate tok/s).
+        ("serve_tok_per_sec", ("serve", "tokens_per_s"), "higher", tok_tol),
+        ("serve_ttft_p99_s", ("serve", "ttft_p99_s"), "lower", serve_lat_tol),
+        ("serve_tpot_p99_s", ("serve", "tpot_p99_s"), "lower", serve_lat_tol),
+        ("decode_kv_tok_per_sec",
+         ("decode", "kv_best_tok_per_sec"), "higher", tok_tol),
     ]
     verdicts = []
     eps = 1e-9
@@ -366,6 +422,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--mfu-tol", type=float, default=0.10)
     parser.add_argument("--mem-tol", type=float, default=0.10)
     parser.add_argument("--loss-tol", type=float, default=0.05)
+    parser.add_argument("--serve-lat-tol", type=float, default=0.25,
+                        help="serve p99 TTFT/TPOT relative tolerance "
+                             "(default 0.25)")
     parser.add_argument("--overhead-tol", type=float, default=0.10,
                         help="ABSOLUTE gate on the checkpoint_save + "
                              "data_wait goodput share: FAIL if the new "
@@ -391,7 +450,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         verdicts = compare(
             base_report, report, tok_tol=args.tok_tol, mfu_tol=args.mfu_tol,
             mem_tol=args.mem_tol, loss_tol=args.loss_tol,
-            overhead_tol=args.overhead_tol)
+            overhead_tol=args.overhead_tol,
+            serve_lat_tol=args.serve_lat_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

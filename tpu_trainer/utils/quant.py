@@ -89,3 +89,23 @@ def dequantize_blockwise_int8(packed: "QuantPack", shape, dtype, *,
     if nonneg:
         y = y * y
     return y.reshape(shape).astype(dtype)
+
+
+def quantize_kv_int8(x: jax.Array):
+    """KV-cache layout wrapper over ``quantize_blockwise_int8``: quantize
+    along head_dim (signed), returning ``(q int8 [..., d],
+    scale f32 [..., d // quant_block_len(d)])`` with the int8 payload
+    reshaped back to the pool's ``[..., head_dim]`` layout so the paged
+    cache stores it block-table-addressable exactly like the fp pool
+    (serving/paged_cache.py; the flash-decode kernel dequantizes gathered
+    blocks in VMEM)."""
+    pack = quantize_blockwise_int8(x, nonneg=False)
+    return pack["q"].reshape(x.shape), pack["scale"]
+
+
+def dequantize_kv_int8(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of ``quantize_kv_int8`` (same signed absmax scheme)."""
+    d = q.shape[-1]
+    nb = scale.shape[-1]
+    pack = QuantPack(q=q.reshape(q.shape[:-1] + (nb, d // nb)), scale=scale)
+    return dequantize_blockwise_int8(pack, q.shape, dtype, nonneg=False)
