@@ -1,0 +1,618 @@
+"""Elastic training tests (ISSUE 7): host-loss survival, mesh-resize
+resume, and the chaos lane.
+
+Fast-lane on purpose — the e2e chaos scenario (a real supervisor losing a
+real host mid-run and recovering on the survivors) is the acceptance test
+of the elastic layer and must run in tier-1, so this module must stay out
+of conftest's ``_SLOW_MODULES``.
+
+Layers covered, cheapest first:
+
+- unit: ``retry_io`` backoff against an injectable failing FS, the one-time
+  sync-fallback warning, stale-commit-marker rejection, heartbeat
+  write/tail-read;
+- in-process integration: a *simulated* two-host two-phase checkpoint
+  (the ``process_index``/``process_of_device`` seams in ``save_checkpoint``)
+  restored onto a different process count and a different ``data×fsdp``
+  factorization, bitwise; cursor remap arithmetic; streaming-loader
+  repartition when the feed world changes;
+- subprocess e2e: the supervisor (``training/elastic.py``) surviving
+  ``kill_host``, detecting ``hang_host`` by heartbeat staleness, and the
+  ``--preemption_grace_s`` SIGTERM drain resuming bit-exactly.
+"""
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel.mesh import MeshConfig, make_mesh
+from tpu_trainer.training.config import TrainingConfig
+from tpu_trainer.training.trainer import ParallelConfig, Trainer
+from tpu_trainer.utils import checkpoint as ckpt
+from tpu_trainer.utils import faults
+from tpu_trainer.utils import flight_recorder as flight_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODEL = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                  max_seq_len=16, dropout=0.0, attention_dropout=0.0)
+TRAIN = TrainingConfig(batch_size=2, max_seq_len=16,
+                       gradient_accumulation_steps=2, max_steps=100,
+                       warmup_steps=5, learning_rate=3e-3,
+                       mixed_precision="fp32", seed=0)
+
+TINY_YAML = """
+model:
+  name: "gpt2-small"
+  vocab_size: 128
+  hidden_size: 32
+  num_layers: 1
+  num_heads: 2
+  intermediate_size: 64
+  max_seq_len: 32
+  dropout: 0.0
+  attention_dropout: 0.0
+  use_flash_attention: false
+training:
+  batch_size: 2
+  learning_rate: 1e-3
+  max_steps: 8
+  warmup_steps: 2
+  log_interval: 1
+  eval_interval: 0
+  save_interval: 2
+  seed: 0
+data:
+  dataset: "dummy"
+"""
+
+
+@pytest.fixture
+def tiny_yaml(tmp_path):
+    p = tmp_path / "tiny.yaml"
+    p.write_text(TINY_YAML)
+    return str(p)
+
+
+def _env():
+    # One CPU device per process, no conftest 8-device override: the point
+    # is crash/elastic semantics, not mesh width — and a multi-process child
+    # with 8 virtual devices each would just slow the rendezvous down.
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def make_trainer(mesh_cfg, strategy):
+    return Trainer(MODEL, TRAIN, ParallelConfig(mesh_cfg, strategy),
+                   mesh=make_mesh(mesh_cfg))
+
+
+def assert_tree_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y)),
+        a, b,
+    )
+
+
+# --- unit: retry/backoff around checkpoint-dir FS ops ----------------------
+
+class TestRetryIO:
+    def test_transient_failures_then_success(self):
+        calls = {"n": 0}
+        sleeps = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                raise OSError("NFS hiccup")
+            return "ok"
+
+        assert ckpt.retry_io(flaky, what="test-op",
+                             sleep=sleeps.append) == "ok"
+        assert calls["n"] == 3
+        # Exponential backoff: each retry waits longer than the previous.
+        assert len(sleeps) == 2 and sleeps[1] > sleeps[0] > 0
+
+    def test_exhausted_attempts_reraise(self):
+        sleeps = []
+
+        def dead():
+            raise OSError("gone for good")
+
+        with pytest.raises(OSError, match="gone for good"):
+            ckpt.retry_io(dead, what="test-op", attempts=3,
+                          sleep=sleeps.append)
+        assert len(sleeps) == 2  # no sleep after the final attempt
+
+    def test_non_retryable_error_passes_through(self):
+        sleeps = []
+
+        def broken():
+            raise ValueError("a bug, not an outage")
+
+        with pytest.raises(ValueError):
+            ckpt.retry_io(broken, what="test-op", sleep=sleeps.append)
+        assert sleeps == []  # never retried
+
+
+class TestSyncFallbackWarning:
+    def test_warns_exactly_once(self, monkeypatch, capsys):
+        monkeypatch.setattr(ckpt, "_SYNC_FALLBACK_WARNED", False)
+        assert ckpt.warn_sync_fallback("test reason") is True
+        assert ckpt.warn_sync_fallback("another reason") is False
+        err = capsys.readouterr().err
+        assert err.count("synchronous save") == 1
+        assert "test reason" in err
+
+
+# --- unit: two-phase commit barrier vs stale markers -----------------------
+
+class TestCommitMarkers:
+    def test_stale_markers_from_other_world_ignored(self, tmp_path):
+        # A dead attempt at world 3 left all three markers behind; the new
+        # attempt at world 2 must not see its barrier satisfied until BOTH
+        # of its own hosts re-marked — else it would commit a mix of fresh
+        # and stale shard files.
+        path = str(tmp_path / "step_00000004")
+        cdir = os.path.join(path, "commit")
+        os.makedirs(cdir)
+        for host in range(3):
+            with open(os.path.join(cdir, f"host{host:05d}.done"), "w") as f:
+                json.dump({"host": host, "world": 3}, f)
+        assert not ckpt._markers_complete(path, 2)
+        for host in range(2):
+            ckpt._mark_host_done(path, host=host, world=2)
+        assert ckpt._markers_complete(path, 2)
+
+    def test_torn_marker_not_ready(self, tmp_path):
+        path = str(tmp_path / "step_00000002")
+        cdir = os.path.join(path, "commit")
+        os.makedirs(cdir)
+        with open(os.path.join(cdir, "host00000.done"), "w"):
+            pass  # zero-byte marker: unreadable, must not count
+        assert not ckpt._markers_complete(path, 1)
+
+
+# --- unit: heartbeats ------------------------------------------------------
+
+class TestHeartbeats:
+    def test_write_and_tail_read(self, tmp_path):
+        hb = flight_lib.HeartbeatWriter(str(tmp_path), host=1)
+        for step in (1, 2, 3):
+            hb.beat(step)
+        beat = flight_lib.read_heartbeat(str(tmp_path), 1)
+        assert beat["step"] == 3 and beat["host"] == 1
+        assert beat["unix"] > 0
+        assert flight_lib.read_heartbeat(str(tmp_path), 0) is None
+
+    def test_torn_tail_line_tolerated(self, tmp_path):
+        hb = flight_lib.HeartbeatWriter(str(tmp_path), host=0)
+        hb.beat(7)
+        with open(hb.path, "a") as f:
+            f.write('{"kind": "heartbeat", "ho')  # crash mid-append
+        beat = flight_lib.read_heartbeat(str(tmp_path), 0)
+        assert beat is not None and beat["step"] == 7
+
+    def test_stop_freezes_stream(self, tmp_path):
+        hb = flight_lib.HeartbeatWriter(str(tmp_path), host=0)
+        hb.beat(1)
+        hb.stop()
+        hb.beat(2)  # the hang_host fault: alive but silent
+        assert flight_lib.read_heartbeat(str(tmp_path), 0)["step"] == 1
+
+
+# --- unit: cursor remap arithmetic -----------------------------------------
+
+class TestRemapDataState:
+    def test_none_passthrough(self):
+        assert ckpt.remap_data_state(
+            None, new_global_batch_size=8) == (None, 0)
+
+    def test_same_gbs_no_replay(self):
+        st, replayed = ckpt.remap_data_state(
+            {"kind": "dummy", "epoch": 1, "batch_index": 5,
+             "global_batch_size": 8, "feed_world": 2},
+            new_global_batch_size=8, new_feed_world=1)
+        assert replayed == 0
+        assert st["batch_index"] == 5 and st["epoch"] == 1
+        assert st["feed_world"] == 1
+
+    def test_shrink_floors_and_replays(self):
+        # 3 batches of 16 sequences consumed; new granularity 12: the
+        # cursor floors to 48 // 12 = 4 with nothing replayed (divisible)...
+        st, replayed = ckpt.remap_data_state(
+            {"kind": "dummy", "epoch": 0, "batch_index": 3,
+             "global_batch_size": 16, "feed_world": 2},
+            new_global_batch_size=12, new_feed_world=1)
+        assert st["batch_index"] == 4 and replayed == 0
+        # ...while a non-divisible resize replays the remainder, never
+        # skipping: 48 sequences onto batches of 10 -> index 4, 8 replayed.
+        st, replayed = ckpt.remap_data_state(
+            {"kind": "dummy", "epoch": 0, "batch_index": 3,
+             "global_batch_size": 16},
+            new_global_batch_size=10)
+        assert st["batch_index"] == 4 and replayed == 8
+        assert st["global_batch_size"] == 10
+
+    def test_pre_elastic_state_unchanged(self):
+        # Checkpoints from before the feed signature existed carry no
+        # global_batch_size; the cursor must pass through untouched.
+        st, replayed = ckpt.remap_data_state(
+            {"kind": "map", "epoch": 2, "batch_index": 9},
+            new_global_batch_size=8)
+        assert st["batch_index"] == 9 and replayed == 0
+
+
+# --- unit: chaos fault targeting -------------------------------------------
+
+class TestFaultTargeting:
+    def test_new_kinds_parse(self):
+        plan = faults.FaultPlan.parse("kill_host@5,hang_host@3,sigterm@4")
+        assert set(plan.pending()) == {("kill_host", 5), ("hang_host", 3),
+                                       ("sigterm", 4)}
+
+    def test_target_host_default_is_highest_rank(self, monkeypatch):
+        monkeypatch.delenv("TPU_TRAINER_FAULT_HOST", raising=False)
+        assert faults.target_host(4) == 3
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "1")
+        assert faults.target_host(4) == 1
+
+    def test_single_process_is_never_targeted(self, monkeypatch):
+        # The supervisor's restarted shrunk run re-arms the same
+        # --inject_fault spec; at world 1 it must be inert or the fault
+        # would kill the recovery it exists to test.
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "0")
+        assert faults.target_host(1) == -1
+
+
+# --- in-process: cross-host-count + cross-factorization resume -------------
+
+class TestCrossHostCountResume:
+    def _train_state(self, trainer, n_steps, seed=3):
+        from tpu_trainer.data.dummy import DummyDataLoader
+
+        state = trainer.init_state()
+        for b in DummyDataLoader(trainer.global_batch_size, 16, 128,
+                                 num_batches=n_steps, seed=seed):
+            state, _ = trainer.train_step(state, trainer.put_batch(b))
+        return state
+
+    def test_two_host_save_restores_anywhere(self, tmp_path):
+        # Save as a SIMULATED two-host pod (4 devices per "host") on a
+        # data=2 x fsdp=4 ZeRO-3 mesh; restore onto (a) one process with a
+        # data=8 replicated mesh — different process count AND different
+        # data x fsdp factorization — and (b) a data=4 x fsdp=2 mesh.
+        t_save = make_trainer(MeshConfig(data=2, fsdp=4), "zero3")
+        state = self._train_state(t_save, 3)
+        data_state = {"kind": "dummy", "epoch": 0, "batch_index": 3,
+                      "seed": 3, **t_save.feed_signature}
+        pod = lambda d: d.id // 4  # noqa: E731
+        for host in (1, 0):  # host 0 last: it runs the commit barrier
+            path = ckpt.save_checkpoint(
+                str(tmp_path), state, model_config=MODEL,
+                training_config=TRAIN, data_state=data_state,
+                process_index=host, process_count=2, process_of_device=pod)
+
+        meta = ckpt.load_meta(path)
+        assert meta["format"] == ckpt.HOST_SHARDS_FORMAT
+        assert meta["shard_world"] == 2
+        assert meta["data_state"]["feed_world"] == t_save.data_feed_world
+
+        t_ddp = make_trainer(MeshConfig(data=8, fsdp=1), "replicated")
+        restored, meta2 = ckpt.restore_checkpoint(path, t_ddp)
+        assert_tree_equal(state.params, restored.params)
+        assert_tree_equal(state.opt_state, restored.opt_state)
+        assert int(restored.step) == 3
+        for leaf in jax.tree_util.tree_leaves(restored.params):
+            assert leaf.sharding.is_fully_replicated
+
+        # Cursor remap onto the restore trainer's feed signature: the
+        # global stream position (3 * old_gbs sequences) is preserved at
+        # the new granularity, replay bounded by one new-sized batch.
+        old_gbs = meta2["data_state"]["global_batch_size"]
+        new_gbs = t_ddp.global_batch_size
+        remapped, replayed = ckpt.remap_data_state(
+            meta2["data_state"], new_global_batch_size=new_gbs,
+            new_feed_world=t_ddp.data_feed_world)
+        consumed = 3 * old_gbs
+        assert remapped["batch_index"] == consumed // new_gbs
+        assert replayed == consumed - (consumed // new_gbs) * new_gbs
+        assert 0 <= replayed < new_gbs
+        assert remapped["feed_world"] == t_ddp.data_feed_world
+
+        # ...and training continues on the new mesh.
+        from tpu_trainer.data.dummy import DummyDataLoader
+        b = next(iter(DummyDataLoader(t_ddp.global_batch_size, 16, 128,
+                                      num_batches=1, seed=9)))
+        restored, m = t_ddp.train_step(restored, t_ddp.put_batch(b))
+        assert np.isfinite(float(m["loss"]))
+
+        t_other = make_trainer(MeshConfig(data=4, fsdp=2), "zero3")
+        restored_b, _ = ckpt.restore_checkpoint(path, t_other)
+        assert_tree_equal(state.params, restored_b.params)
+
+    def test_partial_two_phase_commit_is_invisible(self, tmp_path):
+        # Crash contract at process_count > 1: shards + a DONE marker with
+        # no meta.json is NOT a checkpoint — the scan skips it and resume
+        # falls back to the previous committed step (what a host death
+        # between phase 1 and phase 2 of the commit leaves behind).
+        t = make_trainer(MeshConfig(data=2, fsdp=4), "zero3")
+        state = self._train_state(t, 2)
+        pod = lambda d: d.id // 4  # noqa: E731
+        for host in (1, 0):
+            good = ckpt.save_checkpoint(
+                str(tmp_path), state, model_config=MODEL,
+                training_config=TRAIN, process_index=host, process_count=2,
+                process_of_device=pod)
+
+        from tpu_trainer.data.dummy import DummyDataLoader
+        b = next(iter(DummyDataLoader(t.global_batch_size, 16, 128,
+                                      num_batches=1, seed=5)))
+        state, _ = t.train_step(state, t.put_batch(b))  # now at step 3
+        # Host 1 writes its shards and marker; host 0 dies before its turn:
+        # no meta.json is ever written.
+        ckpt.save_checkpoint(
+            str(tmp_path), state, model_config=MODEL, training_config=TRAIN,
+            process_index=1, process_count=2, process_of_device=pod)
+
+        torn = str(tmp_path / "step_00000003")
+        assert os.path.isdir(os.path.join(torn, "shards"))
+        assert os.path.exists(os.path.join(torn, "commit", "host00001.done"))
+        assert not os.path.exists(os.path.join(torn, "meta.json"))
+        assert ckpt.latest_checkpoint(str(tmp_path)) == good
+        assert [s for s, _ in ckpt.list_checkpoints(str(tmp_path))] == [2]
+
+
+# --- in-process: streaming repartition when the feed world changes ---------
+
+class TestStreamingRepartition:
+    def test_feed_world_change_never_skips_lines(self, tmp_path):
+        # 12 lines, each exactly seq_len tokens with the byte tokenizer
+        # (31 chars + EOS), so chunk == line and coverage is countable.
+        from tpu_trainer.data.text import StreamingTextDataset, TextDataLoader
+
+        seq_len = 32
+        path = tmp_path / "corpus.txt"
+        path.write_text("".join(
+            f"line{i:02d}".ljust(seq_len - 1, "x") + "\n" for i in range(12)))
+
+        def loader(shard, world, rows):
+            ds = StreamingTextDataset(str(path), seq_len,
+                                      tokenizer_name="byte",
+                                      shard_id=shard, num_shards=world)
+            return TextDataLoader(ds, batch_size=rows, process_index=shard,
+                                  process_count=world, prefetch=0)
+
+        def rows_of(batches):
+            return {bytes(r.tobytes()) for b in batches for r in b}
+
+        all_rows = rows_of(list(loader(0, 1, 12)))
+        assert len(all_rows) == 12
+
+        # World 2: each host consumes 1 batch of 2 rows, then checkpoints.
+        consumed = set()
+        for host in range(2):
+            ld = loader(host, 2, 2)
+            it = iter(ld)
+            consumed |= rows_of([next(it)])
+            sd = ld.state_dict()
+            if hasattr(it, "close"):
+                it.close()
+        assert len(consumed) == 4
+        old_gbs = 2 * 2  # rows_per_host * feed_world
+        saved = dict(sd, global_batch_size=old_gbs, feed_world=2)
+
+        # Resize to world 1 with 3 rows per batch: 4 consumed sequences on
+        # granularity 3 floors to index 1 — one sequence replays.
+        new_gbs = 3
+        remapped, replayed = ckpt.remap_data_state(
+            saved, new_global_batch_size=new_gbs, new_feed_world=1)
+        assert remapped["batch_index"] == 1 and replayed == 1
+
+        resumed = loader(0, 1, 3)
+        resumed.load_state_dict(remapped)
+        resumed_rows = rows_of(list(resumed))
+
+        # At-least-once at batch granularity: together the pre-resize
+        # consumption and the resumed stream cover every line; the overlap
+        # is bounded by one new-sized batch (the documented replay window).
+        assert consumed | resumed_rows == all_rows
+        assert len(consumed & resumed_rows) < new_gbs
+
+
+# --- subprocess e2e: the chaos lane ----------------------------------------
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(l) for l in f if l.strip()]
+
+
+def log_losses(log_path):
+    """step -> loss parsed from a trainer log file."""
+    out = {}
+    pat = re.compile(r"step\s+(\d+) \| loss ([0-9.a-z+-]+)")
+    with open(log_path) as f:
+        for line in f:
+            m = pat.search(line)
+            if m:
+                out[int(m.group(1))] = float(m.group(2))
+    return out
+
+
+def run_supervisor(run_dir, tiny_yaml, *, num_processes=2, max_restarts=2,
+                   heartbeat_timeout_s=30.0, trainer_args=(), timeout=420,
+                   **sup_kw):
+    cmd = [sys.executable, "-m", "tpu_trainer.training.elastic",
+           "--num_processes", str(num_processes),
+           "--run_dir", str(run_dir),
+           "--max_restarts", str(max_restarts),
+           "--heartbeat_timeout_s", str(heartbeat_timeout_s),
+           "--startup_grace_s", "240",
+           "--coordinator_timeout_s", "120"]
+    for k, v in sup_kw.items():
+        cmd += [f"--{k}", str(v)]
+    cmd += ["--", "--config", tiny_yaml,
+            "--checkpoint_dir", os.path.join(str(run_dir), "ckpt"),
+            "--no_comms_model", "--guard_interval", "0", *trainer_args]
+    return subprocess.run(cmd, capture_output=True, text=True, env=_env(),
+                          timeout=timeout)
+
+
+class TestElasticSupervisor:
+    def test_kill_host_shrinks_mesh_and_resumes(self, tiny_yaml, tmp_path):
+        # THE chaos-lane acceptance scenario: 2 processes, rank 1 hard-dies
+        # at step 5; the supervisor must detect the death, tear down the
+        # wedged survivor, reform at world 1, auto-resume from the last
+        # committed checkpoint with the cursor remapped, and finish the run.
+        run_dir = tmp_path / "run"
+        r = run_supervisor(run_dir, tiny_yaml,
+                           trainer_args=("--inject_fault", "kill_host@5"))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        events = read_jsonl(run_dir / "supervisor.jsonl")
+        deaths = [e for e in events if e.get("kind") == "host_death"]
+        assert len(deaths) == 1
+        assert deaths[0]["host"] == 1
+        assert deaths[0]["cause"] == f"exit:{faults.KILL_EXIT_CODE}"
+        recoveries = [e for e in events if e.get("kind") == "recovery"]
+        assert len(recoveries) == 1
+        assert recoveries[0]["world_before"] == 2
+        assert recoveries[0]["world_after"] == 1
+        assert recoveries[0]["recovery_seconds"] >= 0
+        summary = [e for e in events if e.get("kind") == "elastic_summary"]
+        assert summary and summary[-1]["restarts"] == 1
+        assert summary[-1]["exit_code"] == 0
+        goodput = [e for e in events if e.get("kind") == "goodput"]
+        assert goodput and goodput[-1].get("recovery_seconds", 0) > 0
+
+        # The restarted attempt resumed from a committed checkpoint...
+        log1 = run_dir / "host0_attempt1.log"
+        assert log1.exists()
+        assert "resumed from" in log1.read_text()
+        # ...async checkpointing stayed async at world 2: the attempt-0
+        # step-2 save committed through the multi-process two-phase path
+        # (a sync fallback would have written single-process orbax format).
+        # (After the peer dies, host 0's crash-path save MAY legitimately
+        # degrade and fail — its input buffers are poisoned by the torn
+        # all-reduce — so the logs aren't scanned for the warning.)
+        meta2 = ckpt.load_meta(str(run_dir / "ckpt" / "step_00000002"))
+        assert meta2["format"] == ckpt.HOST_SHARDS_FORMAT
+        assert meta2["shard_world"] == 2
+        # ...and the run completed: a final committed step-8 checkpoint.
+        meta = ckpt.load_meta(str(run_dir / "ckpt" / "step_00000008"))
+        assert meta["step"] == 8
+        assert meta["data_state"]["feed_world"] == 1  # stamped post-shrink
+
+        # Continuous loss trajectory: between the two attempts every step
+        # of the run was trained and logged (steps 0..7 plus the final
+        # drained record; overlap = the at-least-once replay window) and
+        # every logged loss is finite.
+        losses = log_losses(run_dir / "host0_attempt0.log")
+        losses.update(log_losses(log1))
+        assert set(losses) == set(range(9))
+        assert all(np.isfinite(v) for v in losses.values())
+
+        # Satellite 6 end to end: analyze.py summarizes the recovery and
+        # its gates run over supervisor.jsonl.
+        r2 = subprocess.run(
+            [sys.executable, "-m", "tpu_trainer.tools.analyze",
+             str(run_dir / "supervisor.jsonl"),
+             "--compare", str(run_dir / "supervisor.jsonl")],
+            capture_output=True, text=True, env=_env(), timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        assert "PASS recovery_seconds_max" in r2.stdout
+        assert "PASS elastic_restarts" in r2.stdout
+        r3 = subprocess.run(
+            [sys.executable, "-m", "tpu_trainer.tools.analyze",
+             str(run_dir / "supervisor.jsonl"),
+             "--compare", str(run_dir / "supervisor.jsonl"),
+             "--recovery-tol", "1e-9"],
+            capture_output=True, text=True, env=_env(), timeout=120)
+        assert r3.returncode == 1
+        assert "FAIL recovery_seconds_max" in r3.stdout
+
+    def test_hang_host_caught_by_heartbeat_timeout(self, tiny_yaml, tmp_path):
+        # A wedged host never exits — only heartbeat staleness can catch
+        # it. max_restarts=0 keeps the test bounded: detection itself (not
+        # recovery, which the kill_host test covers) is the assertion.
+        run_dir = tmp_path / "run"
+        r = run_supervisor(
+            run_dir, tiny_yaml, max_restarts=0, heartbeat_timeout_s=3,
+            trainer_args=("--inject_fault", "hang_host@3",
+                          "--max_steps", "100000",
+                          "--save_interval", "100000"))
+        assert r.returncode == 1, r.stdout + r.stderr
+        events = read_jsonl(run_dir / "supervisor.jsonl")
+        deaths = [e for e in events if e.get("kind") == "host_death"]
+        # Exactly ONE death even though the survivor's beats also go stale
+        # (it wedges in a collective with the silent peer): the supervisor
+        # blames the earliest flatline, not every stalled host. Which rank
+        # that heuristic picks depends on scheduling, so only the cause is
+        # pinned.
+        assert len(deaths) == 1
+        assert deaths[0]["cause"] == "heartbeat_timeout"
+        assert deaths[0]["host"] in (0, 1)
+        summary = [e for e in events if e.get("kind") == "elastic_summary"]
+        assert summary and summary[-1]["exit_code"] == 1
+        assert summary[-1]["restarts"] == 0
+
+
+class TestPreemptionGrace:
+    def run_trainer(self, tiny_yaml, ckpt_dir, *extra, timeout=240):
+        cmd = [sys.executable, "-m", "tpu_trainer.training.train_ddp",
+               "--config", tiny_yaml, "--checkpoint_dir", str(ckpt_dir),
+               *extra]
+        return subprocess.run(cmd, capture_output=True, text=True,
+                              env=_env(), timeout=timeout)
+
+    def test_sigterm_with_grace_resumes_bit_exact(self, tiny_yaml, tmp_path):
+        # sigterm@4 delivers a real SIGTERM through the actual handler; the
+        # grace budget drains the in-flight async save and lands the final
+        # checkpoint, exiting 143 — and the resumed run replays nothing:
+        # combined per-step losses equal an uninterrupted reference run's,
+        # float for float.
+        ref = self.run_trainer(tiny_yaml, tmp_path / "ckref",
+                               "--no_auto_resume",
+                               "--metrics_jsonl", str(tmp_path / "ref.jsonl"))
+        assert ref.returncode == 0, ref.stderr
+
+        ck = tmp_path / "ck"
+        hit = self.run_trainer(tiny_yaml, ck,
+                               "--inject_fault", "sigterm@4",
+                               "--preemption_grace_s", "120",
+                               "--metrics_jsonl", str(tmp_path / "m1.jsonl"))
+        assert hit.returncode == 143, hit.stdout + hit.stderr
+        assert "SIGTERM received" in hit.stdout
+        # The grace never expired: the preempt checkpoint is complete.
+        saved = sorted(d for d in os.listdir(ck) if d.startswith("step_"))
+        assert saved
+        meta = ckpt.load_meta(str(ck / saved[-1]))
+        assert meta["step"] >= 4
+
+        resumed = self.run_trainer(tiny_yaml, ck,
+                                   "--metrics_jsonl",
+                                   str(tmp_path / "m2.jsonl"))
+        assert resumed.returncode == 0, resumed.stderr
+        assert "resumed from" in resumed.stdout
+
+        def losses(p):
+            out = {}
+            for rec in read_jsonl(p):
+                if rec.get("kind", "train") == "train" and "loss" in rec:
+                    out[rec["step"]] = rec["loss"]
+            return out
+
+        want = losses(tmp_path / "ref.jsonl")
+        got = losses(tmp_path / "m1.jsonl")
+        got.update(losses(tmp_path / "m2.jsonl"))
+        assert got == want

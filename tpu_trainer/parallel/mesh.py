@@ -104,10 +104,30 @@ def initialize_distributed(
     num_processes = num_processes or _int_env("NUM_PROCESSES")
     process_id = process_id if process_id is not None else _int_env("PROCESS_ID")
     if coordinator_address or num_processes:
+        if (num_processes or 0) > 1:
+            # CPU cross-process collectives default to "none" on this jax,
+            # which makes any multi-process computation fail with
+            # "Multiprocess computations aren't implemented on the CPU
+            # backend". Selecting gloo before backend init turns the
+            # supervisor's N-process CPU rendezvous (and the elastic chaos
+            # tests) into a real collective fabric. Must happen before the
+            # first backend instantiation; harmless on TPU (ignored).
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass  # older/newer jax without the knob: leave the default
+        kwargs = {}
+        timeout_s = _int_env("COORDINATOR_TIMEOUT_S")
+        if timeout_s is not None:
+            # Bounded rendezvous: a peer that died before reaching
+            # initialize() must surface as an error the run supervisor can
+            # see, not an indefinite hang of the surviving processes.
+            kwargs["initialization_timeout"] = timeout_s
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **kwargs,
         )
         return
     if auto is None:

@@ -9,11 +9,17 @@ records — into a human report: step-time percentiles, tok/s stability,
 the goodput table, spike/rollback/recompile events, and the comms share
 of the step. ``serve`` records (benchmarks/serve_bench.py) and ``decode``
 records (benchmarks/decode_bench.py) fold into the same report, so one
-file can carry a whole train+serve CI run. With ``--compare`` it renders
-PASS/FAIL verdicts for the new run against a baseline run on throughput,
-MFU, peak HBM, final loss, serving tok/s and p99 tail latency, and
-decode-path tok/s, and exits nonzero on any FAIL — a CI-usable gate over the bench
-trajectory (exit 0 clean, 1 regression, 2 unreadable/mis-schema'd input).
+file can carry a whole train+serve CI run. The elastic supervisor's
+``supervisor.jsonl`` (``host_death`` / ``recovery`` / ``elastic_summary``
+records, see training/elastic.py) folds in too: the report shows each
+restart's detection-to-first-step recovery time. With ``--compare`` it
+renders PASS/FAIL verdicts for the new run against a baseline run on
+throughput, MFU, peak HBM, final loss, serving tok/s and p99 tail
+latency, and decode-path tok/s — plus two elastic gates: an ABSOLUTE cap
+on per-restart recovery seconds (``--recovery-tol``) and a
+restart-count-regression check — and exits nonzero on any FAIL — a
+CI-usable gate over the bench trajectory (exit 0 clean, 1 regression,
+2 unreadable/mis-schema'd input).
 
 Every record must carry the ``schema_version`` stamp MetricLogger writes;
 unversioned or mismatched records abort with exit 2 so old runs fail
@@ -217,6 +223,27 @@ def summarize(records: List[dict]) -> dict:
                       default=None)
         report["decode"] = {"paths": paths, "kv_best_tok_per_sec": kv_best}
 
+    deaths = by_kind.get("host_death", [])
+    recoveries = by_kind.get("recovery", [])
+    esummary = by_kind.get("elastic_summary", [])
+    if deaths or recoveries or esummary:
+        rec_secs = [r.get("recovery_seconds") for r in recoveries
+                    if r.get("recovery_seconds") is not None]
+        summary = esummary[-1] if esummary else {}
+        report["elastic"] = {
+            "restarts": summary.get("restarts", len(recoveries)),
+            "final_world": summary.get("final_world"),
+            "supervisor_exit_code": summary.get("exit_code"),
+            "deaths": [{"host": d.get("host"), "cause": d.get("cause")}
+                       for d in deaths],
+            "recovery_seconds": rec_secs,
+            "recovery_seconds_total": summary.get(
+                "recovery_seconds_total", sum(rec_secs) or None),
+            "recovery_seconds_max": max(rec_secs, default=None),
+            "worlds": [[r.get("world_before"), r.get("world_after")]
+                       for r in recoveries],
+        }
+
     telemetry_steps = [r.get("step") for r in train
                        if any(k.startswith("telemetry/") for k in r)]
     if telemetry_steps:
@@ -306,6 +333,18 @@ def render(report: dict) -> List[str]:
         tbl = "  ".join(f"{k} {_fmt(v, 0)}"
                         for k, v in sorted(d["paths"].items()))
         lines.append(f"decode  tok/s: {tbl}")
+    el = report.get("elastic")
+    if el:
+        deaths = "  ".join(f"host{d['host']}({d['cause']})"
+                           for d in el["deaths"]) or "none"
+        worlds = "  ".join(f"{a}→{b}" for a, b in el["worlds"])
+        lines.append(
+            f"elastic {el['restarts']} restart(s) | deaths: {deaths}"
+            + (f" | world {worlds}" if worlds else "")
+            + f" | recovery total {_fmt(el.get('recovery_seconds_total'), 1)}s"
+              f" max {_fmt(el.get('recovery_seconds_max'), 1)}s"
+            + (f" | supervisor exit {el['supervisor_exit_code']}"
+               if el.get("supervisor_exit_code") is not None else ""))
     return lines
 
 
@@ -314,18 +353,32 @@ def render(report: dict) -> List[str]:
 def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             mfu_tol: float = 0.10, mem_tol: float = 0.10,
             loss_tol: float = 0.05, overhead_tol: float = 0.10,
-            serve_lat_tol: float = 0.25) -> List[dict]:
+            serve_lat_tol: float = 0.25,
+            recovery_tol: float = 120.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
     tok/s loss fails the default gate); metrics absent from either run
     SKIP (CPU runs have no MFU or HBM) — SKIP never fails CI.
 
-    ``overlap_overhead`` is the one ABSOLUTE gate: the goodput share lost
+    ``overlap_overhead`` is an ABSOLUTE gate: the goodput share lost
     to ``checkpoint_save + data_wait``. The overlap engine (ISSUE 4) exists
     to keep that share near zero, so a run whose combined share grows by
     >= ``overhead_tol`` (fraction-of-wall-clock points, not relative — a
     0.1% -> 0.2% doubling is noise, 2% -> 12% is a broken overlap) FAILs.
+
+    Two elastic gates (ISSUE 7) cover chaos-lane runs:
+
+    - ``recovery_seconds_max`` is ABSOLUTE too, but against a fixed
+      budget rather than the baseline: the slowest single host-death
+      recovery (death detected -> first post-restart heartbeat) must stay
+      under ``recovery_tol`` seconds regardless of what the baseline did
+      — a recovery that was already slow must not grandfather itself in.
+    - ``elastic_restarts`` fails when the new run needed MORE restarts
+      than the baseline of the same chaos scenario (each injected fault
+      should cost exactly one restart; a second one means the first
+      recovery itself died). SKIP when the baseline has no elastic
+      records to anchor the count.
     """
     def get(report, *keys):
         cur = report
@@ -392,6 +445,35 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "tolerance_pct": round(overhead_tol * 100, 2),
             "absolute": True,
         })
+
+    new_rec_max = get(new, "elastic", "recovery_seconds_max")
+    if new_rec_max is None:
+        verdicts.append({"metric": "recovery_seconds_max", "verdict": "SKIP",
+                         "base": get(base, "elastic", "recovery_seconds_max"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "recovery_seconds_max",
+            "verdict": "FAIL" if new_rec_max >= recovery_tol - eps else "PASS",
+            "base": get(base, "elastic", "recovery_seconds_max"),
+            "new": round(new_rec_max, 2),
+            "tolerance_s": recovery_tol,
+            "absolute": True,
+        })
+
+    b_restarts = get(base, "elastic", "restarts")
+    n_restarts = get(new, "elastic", "restarts")
+    if b_restarts is None or n_restarts is None:
+        verdicts.append({"metric": "elastic_restarts", "verdict": "SKIP",
+                         "base": b_restarts, "new": n_restarts})
+    else:
+        verdicts.append({
+            "metric": "elastic_restarts",
+            "verdict": "FAIL" if n_restarts > b_restarts else "PASS",
+            "base": b_restarts,
+            "new": n_restarts,
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -400,12 +482,18 @@ def render_verdicts(verdicts: List[dict]) -> List[str]:
     for v in verdicts:
         if v["verdict"] == "SKIP":
             lines.append(f"SKIP {v['metric']:<16} (absent in one run)")
-        else:
+        elif "delta_pct" in v:
             kind = " abs" if v.get("absolute") else ""
             lines.append(
                 f"{v['verdict']} {v['metric']:<16} base {_fmt(v['base'], 4)}"
                 f" new {_fmt(v['new'], 4)} ({v['delta_pct']:+.1f}%{kind},"
                 f" tol {v['tolerance_pct']:.0f}%{kind})")
+        else:
+            tol = (f", tol {_fmt(v['tolerance_s'], 0)}s abs"
+                   if v.get("tolerance_s") is not None else "")
+            lines.append(
+                f"{v['verdict']} {v['metric']:<16} base {_fmt(v['base'], 2)}"
+                f" new {_fmt(v['new'], 2)} (absolute{tol})")
     return lines
 
 
@@ -430,6 +518,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "data_wait goodput share: FAIL if the new "
                              "run's share grows by >= this many fraction-"
                              "of-wall-clock points (default 0.10)")
+    parser.add_argument("--recovery-tol", type=float, default=120.0,
+                        help="ABSOLUTE gate on elastic recovery: FAIL if "
+                             "any single host-death recovery in the new "
+                             "run took >= this many seconds (default 120)")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -451,7 +543,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             base_report, report, tok_tol=args.tok_tol, mfu_tol=args.mfu_tol,
             mem_tol=args.mem_tol, loss_tol=args.loss_tol,
             overhead_tol=args.overhead_tol,
-            serve_lat_tol=args.serve_lat_tol)
+            serve_lat_tol=args.serve_lat_tol,
+            recovery_tol=args.recovery_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

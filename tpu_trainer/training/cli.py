@@ -31,6 +31,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import signal
+import time
 from typing import Optional
 
 import numpy as np
@@ -182,7 +183,14 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                    help="debug: deterministic fault injection, "
                         "'kind@step[,kind@step...]' — kinds: nan_loss, "
                         "loss_spike, kill, kill_in_save, truncate_meta, "
-                        "corrupt_shard (utils/faults.py)")
+                        "corrupt_shard, sigterm, kill_host, hang_host "
+                        "(utils/faults.py)")
+    p.add_argument("--preemption_grace_s", type=float, default=None,
+                   help="hard deadline (seconds) for the SIGTERM exit path: "
+                        "drain the in-flight async save and take the final "
+                        "checkpoint within this budget, exiting 143 even if "
+                        "the save had to be abandoned (0 = wait "
+                        "indefinitely, the pre-elastic behavior)")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--wandb_project", type=str, default=None,
                    help="log metrics to Weights & Biases (import-guarded)")
@@ -499,6 +507,8 @@ def resolve_configs(args, mode: str):
         "rollback_lr_backoff": _pickf(args.rollback_lr_backoff,
                                       y_ft.get("rollback_lr_backoff"), 0.5),
         "inject_fault": args.inject_fault,
+        "preemption_grace_s": _pickf(args.preemption_grace_s,
+                                     y_ft.get("preemption_grace_s"), 0.0),
         # Telemetry / goodput / early warning (utils/telemetry.py).
         "telemetry_interval": _picki(args.telemetry_interval, None, 0),
         "spike_sigma": _pickf(args.spike_sigma, None, 6.0),
@@ -663,6 +673,19 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     if data_state is not None and hasattr(train_loader, "load_state_dict"):
         # Exact data resume: continue at the consumed-batch cursor saved in
         # the checkpoint instead of re-reading the dataset from the start.
+        # If this run's mesh resized the global batch or feed world since
+        # the save (elastic restart on fewer hosts), remap the cursor onto
+        # the new batch granularity first — at-least-once semantics, never
+        # skipping data.
+        data_state, replayed = ckpt_lib.remap_data_state(
+            data_state,
+            new_global_batch_size=trainer.global_batch_size,
+            new_feed_world=trainer.data_feed_world,
+        )
+        if replayed and main:
+            print(f"data cursor remapped for the resized mesh: replaying "
+                  f"{replayed} already-seen sequences (at-least-once window, "
+                  f"batch granularity)", flush=True)
         try:
             train_loader.load_state_dict(data_state)
         except ValueError as e:
@@ -678,6 +701,21 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             snapshot=flight_lib.env_snapshot(
                 trainer=trainer, model_config=model_config,
                 training_config=training_config, argv=argv),
+        )
+
+    # --- heartbeats for the elastic run supervisor ---------------------
+    # The supervisor (training/elastic.py) exports TPU_TRAINER_HEARTBEAT_DIR
+    # to its children; standalone runs skip this entirely. One beat per
+    # completed step — the supervisor's staleness check is how a hung (not
+    # dead) host gets caught.
+    heartbeat = None
+    hb_dir = os.environ.get("TPU_TRAINER_HEARTBEAT_DIR")
+    if hb_dir:
+        heartbeat = flight_lib.HeartbeatWriter(
+            hb_dir, host=trainer.process_index,
+            min_interval_s=float(
+                os.environ.get("TPU_TRAINER_HEARTBEAT_INTERVAL_S", "0")),
+            recorder=recorder,
         )
 
     def dump_flight(reason: str, exc: Optional[BaseException] = None):
@@ -752,10 +790,14 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 faults.clear()
 
     # --- preemption handler (TPU maintenance SIGTERM) ------------------
-    preempted = {"hit": False}
+    # "at" anchors the --preemption_grace_s deadline at signal receipt, not
+    # at the (cadenced) vote that notices it.
+    preempted = {"hit": False, "at": None}
 
     def _on_sigterm(signum, frame):
         preempted["hit"] = True
+        if preempted["at"] is None:
+            preempted["at"] = time.monotonic()
 
     old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
 
@@ -767,19 +809,39 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     # and the drain costs ~nothing).
     saver = ckpt_lib.AsyncSaver() if training_config.async_checkpointing else None
 
-    def drain_save():
+    def drain_save(timeout: Optional[float] = None) -> bool:
+        """Drain the in-flight async commit; False when ``timeout`` expired
+        with the commit still running (daemon writer — it dies with the
+        process, leaving the usual crash-safe meta-less tree)."""
         if saver is not None and saver.in_flight:
             with ledger.track("checkpoint_commit_wait"):
-                saver.wait()
+                saver.wait(timeout)
+            return not saver.in_flight
+        return True
 
-    def save(tag: str = "", wait: bool = False):
-        drain_save()
+    def save(tag: str = "", wait: bool = False,
+             deadline: Optional[float] = None):
+        if deadline is not None:
+            # Preemption grace: both drains are bounded by the remaining
+            # budget; an expired budget abandons the save rather than
+            # outliving the scheduler's kill.
+            if not drain_save(max(0.0, deadline - time.monotonic())):
+                if main:
+                    print("preemption grace spent draining the in-flight "
+                          "commit; skipping the final checkpoint", flush=True)
+                return
+        else:
+            drain_save()
         with ledger.track("checkpoint_save"):
             # The feed's cursor, not the raw loader's: with device prefetch
             # the loader runs up to depth batches ahead of what the trainer
             # consumed, and resuming from its cursor would skip the
-            # buffered batches.
+            # buffered batches. The feed signature (global batch size, feed
+            # world) rides along so an elastic restart on a resized mesh
+            # can remap the cursor's units.
             data_sd = feed.state_dict()
+            if data_sd is not None:
+                data_sd = dict(data_sd, **trainer.feed_signature)
             save_fn = saver.save if saver is not None else ckpt_lib.save_checkpoint
             path = save_fn(
                 training_config.checkpoint_dir, state,
@@ -791,7 +853,13 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         if wait:
             # Terminal saves (final/preempt/crash): the process is about to
             # exit, so the checkpoint must be durable before we return.
-            drain_save()
+            if not drain_save(None if deadline is None
+                              else max(0.0, deadline - time.monotonic())):
+                if main:
+                    print("preemption grace expired before the final commit "
+                          "landed; exiting with the commit in flight",
+                          flush=True)
+                return
         if main:
             print(f"saved checkpoint{' (' + tag + ')' if tag else ''}: {path}")
 
@@ -932,6 +1000,24 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                 for step in range(start_step, training_config.max_steps):
                     if faults.fire("kill", step):
                         faults.kill()
+                    if faults.fire("sigterm", step):
+                        # A preemption notice that DID arrive: deliver a real
+                        # SIGTERM to ourselves so the drain/grace exit path
+                        # is exercised through the actual handler.
+                        os.kill(os.getpid(), signal.SIGTERM)
+                    if faults.fire("kill_host", step) and (
+                            trainer.process_index
+                            == faults.target_host(trainer.process_count)):
+                        # Chaos lane: this rank dies hard; the others keep
+                        # running until the supervisor reforms the mesh.
+                        faults.kill()
+                    if faults.fire("hang_host", step) and (
+                            trainer.process_index
+                            == faults.target_host(trainer.process_count)):
+                        # Chaos lane: look dead without dying — only the
+                        # supervisor's heartbeat-staleness check catches it.
+                        if heartbeat is not None:
+                            heartbeat.stop()
                     # profiler.step returns a StepTraceAnnotation context
                     # inside the trace window (per-step grouping in the
                     # viewer), a nullcontext outside it.
@@ -966,6 +1052,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                             if faults.fire("loss_spike", step):
                                 transform = _loss_spike_transform
                             consume(deferred.push(step, metrics, transform))
+                    if heartbeat is not None:
+                        heartbeat.beat(step + 1)
                     wd_rec = watchdog.observe(step, batch,
                                               expected=expected_compile)
                     if wd_rec is not None:
@@ -1055,7 +1143,12 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                         if main:
                             print("SIGTERM received: checkpointing and exiting")
                         consume(deferred.drain(), check=False)
-                        save("preempt", wait=True)
+                        grace = data_opts["preemption_grace_s"]
+                        deadline = None
+                        if grace and grace > 0:
+                            deadline = (preempted["at"] or time.monotonic()
+                                        ) + grace
+                        save("preempt", wait=True, deadline=deadline)
                         dump_flight("sigterm")
                         return 143
                 consume(deferred.drain())

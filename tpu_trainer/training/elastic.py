@@ -1,0 +1,385 @@
+"""Elastic run supervisor: host-loss survival for multi-process training.
+
+``python -m tpu_trainer.training.elastic --num_processes N --run_dir DIR \\
+    -- --config tiny.yaml --checkpoint_dir DIR/ckpt ...``
+
+launches N trainer processes (``train_ddp``/``train_fsdp`` over
+``jax.distributed`` — on CPU the gloo collective fabric selected by
+``parallel/mesh.initialize_distributed``), watches them, and keeps the run
+alive through host loss:
+
+1. **Launch**: each child gets ``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/
+   ``PROCESS_ID`` (the env rendezvous ``mesh.initialize_distributed``
+   reads), a bounded ``COORDINATOR_TIMEOUT_S``, and
+   ``TPU_TRAINER_HEARTBEAT_DIR`` pointing at this attempt's heartbeat
+   directory (``training/cli.py`` writes one beat per completed step
+   through the flight-recorder path, ``utils/flight_recorder.py``).
+2. **Watch**: a host is declared dead on (a) nonzero exit — a crash, OOM
+   kill, or preemption that outran its grace — or (b) heartbeat staleness
+   past ``--heartbeat_timeout_s`` — a *hung* host that holds the whole pod's
+   collectives hostage without ever exiting (the failure mode exit codes
+   cannot see; the ``hang_host`` chaos fault drives exactly this).
+3. **Reform**: on any death the surviving processes are torn down too (they
+   are blocked inside collectives with a dead peer and cannot make
+   progress), the world shrinks to the survivors, and the run relaunches.
+   Auto-resume restores the last *committed* checkpoint — the two-phase
+   commit in ``utils/checkpoint.py`` guarantees a host death mid-save left
+   either a complete meta.json or an invisible meta-less tree — and the
+   cursor remap (``remap_data_state``) re-bases the data stream onto the
+   resized mesh's batch granularity.
+
+Every death/restart writes JSONL records to ``<run_dir>/supervisor.jsonl``:
+``kind:"host_death"``, ``kind:"recovery"`` (detection -> first post-restart
+step, the new ``recovery`` goodput category), and a final
+``kind:"elastic_summary"`` — ``tools/analyze.py`` summarizes them and gates
+on recovery time and restart-count regressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from tpu_trainer.utils import flight_recorder as flight_lib
+from tpu_trainer.utils import telemetry as telemetry_lib
+from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+# Child teardown: SIGTERM, then SIGKILL after this many seconds. Short —
+# by the time the supervisor tears a survivor down it is wedged in a
+# collective with a dead peer, and its last committed checkpoint is
+# already durable (a mid-save death cannot produce a half-committed one).
+_TERM_GRACE_S = 5.0
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Child:
+    """One trainer process of the current attempt."""
+
+    def __init__(self, host: int, proc: subprocess.Popen, log_path: str,
+                 log_file):
+        self.host = host
+        self.proc = proc
+        self.log_path = log_path
+        self.log_file = log_file
+        self.exited: Optional[int] = None  # exit code once reaped
+
+    def poll(self) -> Optional[int]:
+        if self.exited is None:
+            rc = self.proc.poll()
+            if rc is not None:
+                self.exited = rc
+                self.log_file.close()
+        return self.exited
+
+
+class Supervisor:
+    """Launch/watch/reform loop around N trainer processes.
+
+    ``trainer_argv`` is the child CLI (everything after ``--``); the
+    supervisor owns ``--num_processes`` down to ``--min_processes`` and
+    gives up after ``--max_restarts`` reforms (a deterministic crash would
+    otherwise restart forever).
+    """
+
+    def __init__(
+        self,
+        trainer_argv: List[str],
+        *,
+        num_processes: int,
+        run_dir: str,
+        mode: str = "ddp",
+        max_restarts: int = 2,
+        min_processes: int = 1,
+        heartbeat_timeout_s: float = 30.0,
+        startup_grace_s: float = 300.0,
+        poll_interval_s: float = 0.2,
+        coordinator_timeout_s: float = 60.0,
+        env: Optional[Dict[str, str]] = None,
+    ):
+        if num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        self.trainer_argv = list(trainer_argv)
+        self.world = int(num_processes)
+        self.run_dir = os.path.abspath(run_dir)
+        self.mode = mode
+        self.max_restarts = int(max_restarts)
+        self.min_processes = int(min_processes)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.coordinator_timeout_s = float(coordinator_timeout_s)
+        self.base_env = dict(os.environ if env is None else env)
+        self.restarts = 0
+        self.attempt = 0
+        self.ledger = telemetry_lib.GoodputLedger()
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.events_path = os.path.join(self.run_dir, "supervisor.jsonl")
+
+    # --- plumbing -------------------------------------------------------
+
+    def _log(self, msg: str) -> None:
+        print(f"elastic | {msg}", flush=True)
+
+    def _emit(self, record: dict) -> None:
+        record = dict(record, schema_version=SCHEMA_VERSION, unix=time.time())
+        with open(self.events_path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+            fh.flush()
+
+    def _hb_dir(self) -> str:
+        # Per-attempt heartbeat directories: a stale beat file from the
+        # previous attempt must not trip the staleness check (or satisfy
+        # the first-beat recovery probe) of the next one.
+        return os.path.join(self.run_dir, "heartbeats",
+                            f"attempt{self.attempt}")
+
+    def _launch(self) -> List[_Child]:
+        port = _free_port()
+        hb_dir = self._hb_dir()
+        os.makedirs(hb_dir, exist_ok=True)
+        children = []
+        for host in range(self.world):
+            env = dict(self.base_env)
+            env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+            env["NUM_PROCESSES"] = str(self.world)
+            env["PROCESS_ID"] = str(host)
+            # A peer that dies before the rendezvous must become an error
+            # the survivors (and this loop) can see, not an infinite wait.
+            env["COORDINATOR_TIMEOUT_S"] = str(int(self.coordinator_timeout_s))
+            env["TPU_TRAINER_HEARTBEAT_DIR"] = hb_dir
+            log_path = os.path.join(
+                self.run_dir, f"host{host}_attempt{self.attempt}.log")
+            log_file = open(log_path, "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 f"tpu_trainer.training.train_{self.mode}",
+                 *self.trainer_argv],
+                stdout=log_file, stderr=subprocess.STDOUT, env=env,
+            )
+            children.append(_Child(host, proc, log_path, log_file))
+        self._log(f"attempt {self.attempt}: launched {self.world} "
+                  f"process(es), coordinator 127.0.0.1:{port}, "
+                  f"heartbeats {hb_dir}")
+        return children
+
+    def _teardown(self, children: List[_Child]) -> None:
+        for c in children:
+            if c.poll() is None:
+                try:
+                    c.proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + _TERM_GRACE_S
+        for c in children:
+            if c.exited is not None:
+                continue
+            try:
+                c.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    c.proc.kill()
+                except OSError:
+                    pass
+                c.proc.wait()
+            c.poll()
+
+    # --- death detection ------------------------------------------------
+
+    def _check_deaths(self, children: List[_Child], started: float) -> List[dict]:
+        """Dead hosts this poll: nonzero exits plus heartbeat flatlines.
+
+        Exit-based deaths are definitive. Staleness needs attribution: a
+        single hung host stalls every survivor too (they block inside a
+        collective with the silent peer and stop beating shortly after), so
+        by detection time *several* beats may be stale. Blaming them all
+        would shrink the world to nothing over one wedged host — so among
+        stale hosts only the one whose stream flatlined FIRST is declared
+        dead; the stalled survivors get a fresh start in the reformed run.
+        """
+        now = time.time()
+        deaths = []
+        stale = []
+        for c in children:
+            rc = c.poll()
+            if rc is not None and rc != 0:
+                deaths.append({"host": c.host, "cause": f"exit:{rc}",
+                               "exit_code": rc})
+                continue
+            if rc is not None:
+                continue  # clean exit: not a death, just done early/waiting
+            beat = flight_lib.read_heartbeat(self._hb_dir(), c.host)
+            if beat is None:
+                if now - started > self.startup_grace_s:
+                    deaths.append({"host": c.host, "cause": "startup_timeout",
+                                   "exit_code": None})
+            elif now - float(beat["unix"]) > self.heartbeat_timeout_s:
+                stale.append((float(beat["unix"]),
+                              {"host": c.host, "cause": "heartbeat_timeout",
+                               "exit_code": None,
+                               "step_last_beat": beat.get("step")}))
+        if stale:
+            deaths.append(min(stale, key=lambda t: t[0])[1])
+        return deaths
+
+    def _first_beat_unix(self) -> Optional[float]:
+        """Earliest beat of the current attempt — the first post-restart
+        step, closing the recovery window."""
+        best = None
+        for host in range(self.world):
+            beat = flight_lib.read_heartbeat(self._hb_dir(), host)
+            if beat is not None:
+                t = float(beat["unix"])
+                best = t if best is None else min(best, t)
+        return best
+
+    # --- the loop -------------------------------------------------------
+
+    def run(self) -> int:
+        pending_recovery: Optional[dict] = None  # death awaiting 1st new step
+        while True:
+            started = time.time()
+            children = self._launch()
+            try:
+                result = self._watch(children, started, pending_recovery)
+            finally:
+                self._teardown(children)
+            pending_recovery = None
+            if result["outcome"] == "done":
+                self._finish(0)
+                return 0
+            deaths = result["deaths"]
+            detected = result["detected_unix"]
+            for d in deaths:
+                self._emit(dict(d, kind="host_death", attempt=self.attempt,
+                                detected_unix=detected))
+                self._log(f"host {d['host']} dead ({d['cause']})")
+            new_world = self.world - len(deaths)
+            if self.restarts >= self.max_restarts:
+                self._log(f"restart budget exhausted "
+                          f"({self.restarts}/{self.max_restarts}); giving up")
+                self._finish(1)
+                return 1
+            if new_world < self.min_processes:
+                self._log(f"only {new_world} host(s) left "
+                          f"(< min_processes={self.min_processes}); giving up")
+                self._finish(1)
+                return 1
+            self.restarts += 1
+            self.attempt += 1
+            pending_recovery = {
+                "restart": self.restarts,
+                "world_before": self.world,
+                "world_after": new_world,
+                "dead_hosts": [d["host"] for d in deaths],
+                "cause": deaths[0]["cause"],
+                "detected_unix": detected,
+            }
+            self.world = new_world
+            self._log(f"reforming on {self.world} host(s) "
+                      f"(restart {self.restarts}/{self.max_restarts})")
+
+    def _watch(self, children: List[_Child], started: float,
+               pending_recovery: Optional[dict]) -> dict:
+        """Poll until every child exits cleanly (outcome "done") or a death
+        is detected (outcome "death"). Also closes a pending recovery window
+        at the attempt's first heartbeat."""
+        while True:
+            if pending_recovery is not None:
+                first = self._first_beat_unix()
+                if first is not None:
+                    rec = dict(pending_recovery, kind="recovery",
+                               first_step_unix=first,
+                               recovery_seconds=max(
+                                   0.0,
+                                   first - pending_recovery["detected_unix"]))
+                    self.ledger.add("recovery", rec["recovery_seconds"])
+                    self._emit(rec)
+                    self._log(f"recovered in {rec['recovery_seconds']:.1f}s "
+                              f"(restart {rec['restart']}, world "
+                              f"{rec['world_before']}→{rec['world_after']})")
+                    pending_recovery = None
+            deaths = self._check_deaths(children, started)
+            if deaths:
+                return {"outcome": "death", "deaths": deaths,
+                        "detected_unix": time.time()}
+            if all(c.poll() is not None for c in children):
+                # All zero (nonzero would have been a death above).
+                return {"outcome": "done"}
+            time.sleep(self.poll_interval_s)
+
+    def _finish(self, exit_code: int) -> None:
+        self._emit({
+            "kind": "elastic_summary",
+            "restarts": self.restarts,
+            "final_world": self.world,
+            "exit_code": exit_code,
+            "recovery_seconds_total": self.ledger.seconds("recovery"),
+        })
+        self._emit(self.ledger.record(final=True))
+        self._log(f"summary: {self.restarts} restart(s), final world "
+                  f"{self.world}, recovery "
+                  f"{self.ledger.seconds('recovery'):.1f}s total")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_trainer.training.elastic",
+        description="Elastic run supervisor: launch N trainer processes, "
+                    "watch heartbeats/exits, restart on the surviving host "
+                    "set from the last committed checkpoint. Trainer flags "
+                    "go after '--'.",
+    )
+    p.add_argument("--num_processes", type=int, required=True)
+    p.add_argument("--run_dir", type=str, required=True,
+                   help="supervisor state: heartbeats, per-host logs, "
+                        "supervisor.jsonl (the trainer's --checkpoint_dir "
+                        "is its own flag, after '--')")
+    p.add_argument("--mode", choices=["ddp", "fsdp"], default="ddp")
+    p.add_argument("--max_restarts", type=int, default=2)
+    p.add_argument("--min_processes", type=int, default=1)
+    p.add_argument("--heartbeat_timeout_s", type=float, default=30.0)
+    p.add_argument("--startup_grace_s", type=float, default=300.0,
+                   help="allowance before the first beat of an attempt "
+                        "(jax import + compile); only then does beat "
+                        "absence count as a hang")
+    p.add_argument("--poll_interval_s", type=float, default=0.2)
+    p.add_argument("--coordinator_timeout_s", type=float, default=60.0)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        sup_argv, trainer_argv = argv[:split], argv[split + 1:]
+    else:
+        sup_argv, trainer_argv = argv, []
+    args = build_parser().parse_args(sup_argv)
+    sup = Supervisor(
+        trainer_argv,
+        num_processes=args.num_processes,
+        run_dir=args.run_dir,
+        mode=args.mode,
+        max_restarts=args.max_restarts,
+        min_processes=args.min_processes,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        startup_grace_s=args.startup_grace_s,
+        poll_interval_s=args.poll_interval_s,
+        coordinator_timeout_s=args.coordinator_timeout_s,
+    )
+    return sup.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

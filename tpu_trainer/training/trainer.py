@@ -564,6 +564,18 @@ class Trainer:
         return c.batch_size * c.gradient_accumulation_steps * self.dp_size
 
     @property
+    def feed_signature(self) -> dict:
+        """The quantities a persisted loader cursor's units depend on.
+        Stamped into every checkpoint's ``data_state`` so an elastic
+        restart on a differently-factored mesh can remap the cursor
+        (``utils/checkpoint.remap_data_state``) instead of replaying the
+        dataset from the start."""
+        return {
+            "global_batch_size": self.global_batch_size,
+            "feed_world": self.data_feed_world,
+        }
+
+    @property
     def tokens_per_step(self) -> int:
         return self.global_batch_size * self.training_config.max_seq_len
 

@@ -17,11 +17,28 @@ for inference elsewhere" use the reference's pickle served.
 
 Layout::
 
-    <dir>/step_00000100/state/   # orbax pytree of TrainState
+    <dir>/step_00000100/state/   # orbax pytree of TrainState (1 process)
     <dir>/step_00000100/meta.json  # step, tokens_seen, configs, data_state
 
+Multi-process runs use a *two-phase commit* instead of the orbax tree
+(meta.json carries ``format: "host_shards"``)::
+
+    <dir>/step_00000100/shards/host00000.npz   # this host's shards
+    <dir>/step_00000100/shards/host00000.json  # shard manifest
+    <dir>/step_00000100/commit/host00000.done  # phase-1 DONE marker
+    <dir>/step_00000100/meta.json              # host 0, after ALL markers
+
+Phase 1: every process writes its addressable shards and then an atomic
+DONE marker. Phase 2: host 0 polls ``commit/`` (bounded wait with
+backoff — a filesystem barrier, deliberately NOT a jax collective, so it
+is safe from the AsyncSaver's background thread while the main thread
+runs step collectives) and writes meta.json last. Restore reassembles the
+global arrays from every host file and reshards onto the restoring
+trainer's mesh — including a *different* process count or ``data x fsdp``
+factorization (the elastic mesh-resize resume).
+
 Crash-safety contract (the fault-tolerance layer in ``training/cli.py``
-builds on all three):
+builds on all three; identical in both formats):
 
 - A checkpoint is *complete* iff its meta.json parses: meta is written by
   host 0 after every shard landed, so a crash mid-save leaves a directory
@@ -31,6 +48,11 @@ builds on all three):
   back to the previous valid step instead of bricking auto-resume.
 - ``keep_last_n`` garbage-collects completed checkpoints oldest-first;
   in-flight (meta-less) and quarantined directories are never touched.
+
+All checkpoint-directory filesystem ops (meta read/write, marker writes,
+quarantine rename, GC) go through :func:`retry_io` — a small bounded
+retry/backoff helper for the transient I/O errors shared filesystems
+throw under pod-scale load.
 """
 
 from __future__ import annotations
@@ -42,7 +64,8 @@ import re
 import shutil
 import sys
 import threading
-from typing import Any, List, Optional, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -59,6 +82,58 @@ _STEP_DIR_RE = re.compile(r"^step_(\d{8})$")
 # dirs no longer match _STEP_DIR_RE, so every scan ignores them; they are
 # kept on disk for postmortem rather than deleted.
 QUARANTINE_SUFFIX = ".corrupt"
+
+# meta.json "format" value for the multi-process two-phase layout.
+HOST_SHARDS_FORMAT = "host_shards"
+_SHARDS_SUBDIR = "shards"
+_COMMIT_SUBDIR = "commit"
+
+
+def _barrier_timeout_s() -> float:
+    # Bound on the filesystem commit barrier: past this, a missing peer
+    # marker means a host died mid-save and the surviving hosts must error
+    # out (surfaced via AsyncSaver.wait) instead of hanging forever.
+    return float(os.environ.get("TPU_TRAINER_CKPT_BARRIER_TIMEOUT_S", "120"))
+
+
+def retry_io(
+    fn: Callable[[], Any],
+    *,
+    what: str,
+    attempts: int = 4,
+    base_delay_s: float = 0.05,
+    retry_on: Tuple[type, ...] = (OSError,),
+    sleep: Callable[[float], None] = time.sleep,
+) -> Any:
+    """Run ``fn`` with bounded retry + exponential backoff on transient
+    filesystem errors (shared filesystems under pod-scale load throw
+    EIO/ESTALE-class errors that succeed on the next attempt). The final
+    failure re-raises — checkpoint durability errors must surface, not be
+    swallowed. ``sleep`` is injectable so tests don't wait."""
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            delay = base_delay_s * (2 ** attempt)
+            print(
+                f"checkpoint io retry {attempt + 1}/{attempts - 1} for "
+                f"{what}: {type(e).__name__}: {e}; backing off {delay:.2f}s",
+                file=sys.stderr, flush=True,
+            )
+            sleep(delay)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its manifest string, including the ml_dtypes extended
+    types (bfloat16 etc.) numpy alone can't parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency, always present
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 class CheckpointIncompatibleError(ValueError):
@@ -77,11 +152,29 @@ def _read_meta(path: str) -> Optional[dict]:
     """meta.json of a step dir, or None if missing/empty/torn — an
     unreadable meta means an incomplete or corrupt save and must never
     crash a directory scan (a truncated meta.json used to brick
-    auto-resume with JSONDecodeError)."""
+    auto-resume with JSONDecodeError).
+
+    A *missing* meta.json is the normal in-flight-save case and returns
+    None immediately; other OSErrors (transient shared-FS failures) are
+    retried before giving up."""
+    meta_path = os.path.join(path, "meta.json")
+
+    def _read() -> Optional[str]:
+        try:
+            with open(meta_path) as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
     try:
-        with open(os.path.join(path, "meta.json")) as f:
-            meta = json.load(f)
-    except (OSError, ValueError):
+        raw = retry_io(_read, what=f"read {meta_path}")
+    except OSError:
+        return None
+    if raw is None:
+        return None
+    try:
+        meta = json.loads(raw)
+    except ValueError:
         return None
     return meta if isinstance(meta, dict) else None
 
@@ -126,17 +219,24 @@ def quarantine_checkpoint(path: str) -> str:
         dest = f"{path}{QUARANTINE_SUFFIX}.{n}"
         n += 1
     if jax.process_index() == 0:
-        os.rename(path, dest)
+        retry_io(lambda: os.rename(path, dest), what=f"quarantine {path}")
     barrier("checkpoint_quarantine")
     return dest
 
 
-def gc_checkpoints(checkpoint_dir: str, keep_last_n: int) -> List[str]:
+def gc_checkpoints(
+    checkpoint_dir: str, keep_last_n: int, *, sync: bool = True
+) -> List[str]:
     """Delete completed checkpoints beyond the newest ``keep_last_n``.
 
     Only completed checkpoints count toward (and are eligible for) the
     budget: an in-flight save's meta-less directory and quarantined dirs
     are never touched. Returns the deleted paths.
+
+    ``sync=False`` skips the trailing jax-collective barrier — required
+    when called from the two-phase commit (possibly on the AsyncSaver's
+    writer thread, where a collective would race the main thread's step
+    collectives; host 0 alone deletes, which is already safe).
     """
     if keep_last_n <= 0:
         return []
@@ -144,10 +244,205 @@ def gc_checkpoints(checkpoint_dir: str, keep_last_n: int) -> List[str]:
     if jax.process_index() == 0:
         complete = list_checkpoints(checkpoint_dir)
         for _, path in complete[:-keep_last_n]:
-            shutil.rmtree(path, ignore_errors=True)
+            try:
+                retry_io(lambda p=path: shutil.rmtree(p), what=f"gc {path}")
+            except OSError:
+                continue  # GC is best-effort; a stuck dir is retried next save
             removed.append(path)
-    barrier("checkpoint_gc")
+    if sync:
+        barrier("checkpoint_gc")
     return removed
+
+
+class _HostShardSnapshot(list):
+    """Host-side copy of one process's addressable shards (what
+    :func:`host_shard_snapshot` returns) — a distinct type so
+    ``_commit_checkpoint`` can tell it apart from a TrainState."""
+
+
+def host_shard_snapshot(
+    state_like,
+    *,
+    process_of_device=None,
+    host: Optional[int] = None,
+) -> _HostShardSnapshot:
+    """Copy this process's addressable shards of every leaf to host memory.
+
+    Blocks until pending computation writing into ``state_like`` finishes
+    (the mandatory synchronous cost of an async save — ``train_step``
+    donates the state buffers, so the very next step would overwrite what
+    the writer thread is reading). Returns a list of
+    ``{key, global_shape, dtype, shards: [(starts, ndarray)]}`` records;
+    ``key`` is ``jax.tree_util.keystr`` of the leaf path, the stable
+    cross-mesh leaf identity the restore side reassembles against.
+
+    ``process_of_device``/``host`` are injectable for tests that simulate
+    an N-host layout on a single process (the same seam as
+    ``parallel/mesh.host_feed_info``).
+    """
+    pod = process_of_device or (lambda d: d.process_index)
+    me = jax.process_index() if host is None else host
+    leaves = jax.tree_util.tree_flatten_with_path(state_like)[0]
+    out = _HostShardSnapshot()
+    for key_path, leaf in leaves:
+        key = jax.tree_util.keystr(key_path)
+        if isinstance(leaf, jax.Array):
+            shards = []
+            seen = set()
+            for s in leaf.addressable_shards:
+                if pod(s.device) != me:
+                    continue
+                starts = tuple(
+                    0 if sl.start is None else int(sl.start) for sl in s.index
+                )
+                if starts in seen:
+                    # Replicated across this host's local devices: one copy.
+                    continue
+                seen.add(starts)
+                shards.append((starts, np.asarray(s.data)))
+            out.append({
+                "key": key,
+                "global_shape": tuple(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "shards": shards,
+            })
+        else:
+            # Non-jax leaf (plain scalar/ndarray): replicated; host 0 owns it.
+            arr = np.asarray(leaf)
+            shards = [] if me != 0 else [(tuple(0 for _ in arr.shape), arr)]
+            out.append({
+                "key": key,
+                "global_shape": tuple(arr.shape),
+                "dtype": str(arr.dtype),
+                "shards": shards,
+            })
+    return out
+
+
+def _write_host_shards(
+    path: str, snapshot: _HostShardSnapshot, *, host: int, world: int
+) -> None:
+    """Phase 1a: durably write this host's shards + manifest. Shard bytes go
+    into one npz (each array serialized as raw uint8 so extended dtypes like
+    bfloat16 round-trip); the manifest records key/shape/dtype/offsets."""
+    sdir = os.path.join(path, _SHARDS_SUBDIR)
+    os.makedirs(sdir, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, Any] = {"host": host, "world": world, "leaves": []}
+    for li, leaf in enumerate(snapshot):
+        entry = {
+            "key": leaf["key"],
+            "global_shape": list(leaf["global_shape"]),
+            "dtype": leaf["dtype"],
+            "shards": [],
+        }
+        for si, (starts, arr) in enumerate(leaf["shards"]):
+            name = f"l{li}_s{si}"
+            arrays[name] = np.frombuffer(
+                np.ascontiguousarray(arr).tobytes(), dtype=np.uint8
+            )
+            entry["shards"].append({
+                "name": name,
+                "start": [int(x) for x in starts],
+                "shape": [int(x) for x in arr.shape],
+            })
+        manifest["leaves"].append(entry)
+    npz = os.path.join(sdir, f"host{host:05d}.npz")
+    man = os.path.join(sdir, f"host{host:05d}.json")
+
+    def _write() -> None:
+        with open(npz + ".tmp", "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(npz + ".tmp", npz)
+        with open(man + ".tmp", "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(man + ".tmp", man)
+
+    retry_io(_write, what=f"write {npz}")
+
+
+def _mark_host_done(path: str, *, host: int, world: int) -> None:
+    """Phase 1b: atomic per-host DONE marker — this host's shards are
+    durable. Written only after ``_write_host_shards`` returned."""
+    cdir = os.path.join(path, _COMMIT_SUBDIR)
+    os.makedirs(cdir, exist_ok=True)
+    marker = os.path.join(cdir, f"host{host:05d}.done")
+
+    def _write() -> None:
+        with open(marker + ".tmp", "w") as f:
+            json.dump({"host": host, "world": world}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(marker + ".tmp", marker)
+
+    retry_io(_write, what=f"write {marker}")
+
+
+def _await_commit(
+    path: str,
+    ready: Callable[[], bool],
+    *,
+    what: str,
+    timeout_s: Optional[float] = None,
+) -> None:
+    """Bounded filesystem barrier: poll ``ready`` with backoff until true or
+    timeout. Deliberately not a jax collective — safe from the AsyncSaver's
+    writer thread while the main thread runs step collectives; a peer that
+    died mid-save surfaces as TimeoutError instead of a hang."""
+    timeout_s = _barrier_timeout_s() if timeout_s is None else timeout_s
+    deadline = time.monotonic() + timeout_s
+    delay = 0.005
+    while not ready():
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"checkpoint commit barrier timed out after {timeout_s:.0f}s "
+                f"waiting for {what} in {path}"
+            )
+        time.sleep(delay)
+        delay = min(delay * 2, 0.25)
+
+
+def _markers_complete(path: str, world: int) -> bool:
+    """All ``world`` DONE markers present *and written for this world*.
+
+    Counting marker files alone is not enough: a dead attempt's leftover
+    markers in the same step dir (the elastic supervisor re-saves the same
+    step after a restart on a shrunk world) could satisfy the barrier
+    before the current attempt's hosts finished writing — committing a mix
+    of fresh and stale shard files. Each marker records the world it was
+    written for; a marker from a different factorization is ignored, and
+    every re-saving host atomically overwrites its own marker."""
+    cdir = os.path.join(path, _COMMIT_SUBDIR)
+    for host in range(world):
+        marker = os.path.join(cdir, f"host{host:05d}.done")
+        try:
+            with open(marker) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if not isinstance(rec, dict) or rec.get("world") != world:
+            return False
+    return True
+
+
+def _write_meta(path: str, meta: dict) -> None:
+    """Atomic meta.json commit (tmp + fsync + rename): readers see either no
+    meta or a complete one, never a torn write from a live host — torn metas
+    on disk come only from real crashes (or the truncate_meta fault)."""
+    meta_path = os.path.join(path, "meta.json")
+
+    def _write() -> None:
+        with open(meta_path + ".tmp", "w") as f:
+            json.dump(meta, f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(meta_path + ".tmp", meta_path)
+
+    retry_io(_write, what=f"write {meta_path}")
 
 
 def save_checkpoint(
@@ -159,6 +454,9 @@ def save_checkpoint(
     tokens_seen: int = 0,
     data_state: Optional[dict] = None,
     keep_last_n: int = 0,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    process_of_device=None,
 ) -> str:
     """Write a sharded checkpoint; returns its path.
 
@@ -171,8 +469,14 @@ def save_checkpoint(
     resumed run continues the data stream bit-exactly instead of re-reading
     the dataset head. ``keep_last_n > 0`` garbage-collects older completed
     checkpoints after this save lands.
+
+    ``process_index``/``process_count``/``process_of_device`` are injectable
+    seams (mirroring ``parallel/mesh.host_feed_info``) so tests can write a
+    simulated N-host two-phase checkpoint from a single real process — call
+    once per simulated host, host 0 last (host 0's call runs the commit
+    barrier and writes meta).
     """
-    step = int(state.step)
+    step = int(jax.device_get(state.step))
     path = step_dir(checkpoint_dir, step)
     if getattr(state, "params_c", None) is not None:
         # Derived data (the compute-dtype param copy): stripping it keeps
@@ -190,6 +494,9 @@ def save_checkpoint(
         data_state=data_state,
         keep_last_n=keep_last_n,
         use_async_writer=False,
+        process_index=process_index,
+        process_count=process_count,
+        process_of_device=process_of_device,
     )
     return path
 
@@ -206,14 +513,40 @@ def _commit_checkpoint(
     data_state: Optional[dict],
     keep_last_n: int,
     use_async_writer: bool,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    process_of_device=None,
 ) -> None:
     """The durable half of a save, shared by the sync path and AsyncSaver's
     writer thread: write every shard, fire the ``kill_in_save`` fault in the
     window where shards are durable but meta is not, commit meta.json
     (host 0), then GC. ``state_like`` is a TrainState of jax arrays (sync
-    path) or its ``jax.device_get`` host snapshot (async path) — orbax
-    writes both to the same logical tree and restore reshards either onto
-    the restoring trainer's mesh."""
+    path), its ``jax.device_get`` host snapshot (single-process async path),
+    or a :class:`_HostShardSnapshot` (multi-process async path).
+
+    Single-process saves keep the orbax tree layout byte-identical to every
+    prior release; anything with ``process_count > 1`` (real or injected)
+    takes the two-phase host-shards commit, which contains no jax
+    collectives and is therefore safe from the writer thread."""
+    pidx = jax.process_index() if process_index is None else process_index
+    pcount = jax.process_count() if process_count is None else process_count
+    if pcount > 1 or isinstance(state_like, _HostShardSnapshot):
+        simulated = process_index is not None or process_of_device is not None
+        snapshot = (
+            state_like
+            if isinstance(state_like, _HostShardSnapshot)
+            else host_shard_snapshot(
+                state_like, process_of_device=process_of_device, host=pidx
+            )
+        )
+        _commit_two_phase(
+            checkpoint_dir, path, snapshot,
+            step=step, model_config=model_config,
+            training_config=training_config, tokens_seen=tokens_seen,
+            data_state=data_state, keep_last_n=keep_last_n,
+            host=pidx, world=pcount, simulated=simulated,
+        )
+        return
     state_path = os.path.join(path, "state")
     if use_async_writer and jax_compat.ORBAX_ASYNC_OK:
         # Orbax's own async machinery, when this version has it. We still
@@ -237,16 +570,11 @@ def _commit_checkpoint(
         # exact partial state a mid-save preemption leaves behind.
         faults.kill()
     if jax.process_index() == 0:
-        meta = {
-            "step": step,
-            "tokens_seen": int(tokens_seen),
-            "model_config": dataclasses.asdict(model_config),
-            "training_config": dataclasses.asdict(training_config),
-        }
-        if data_state is not None:
-            meta["data_state"] = data_state
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2)
+        _write_meta(path, _meta_dict(
+            step=step, model_config=model_config,
+            training_config=training_config, tokens_seen=tokens_seen,
+            data_state=data_state,
+        ))
     barrier("checkpoint_meta")
     if faults.fire("truncate_meta", step):
         faults.truncate_file(os.path.join(path, "meta.json"))
@@ -254,6 +582,114 @@ def _commit_checkpoint(
         _corrupt_some_shard(path)
     if keep_last_n > 0:
         gc_checkpoints(checkpoint_dir, keep_last_n)
+
+
+def _meta_dict(
+    *,
+    step: int,
+    model_config: GPTConfig,
+    training_config: TrainingConfig,
+    tokens_seen: int,
+    data_state: Optional[dict],
+) -> dict:
+    meta = {
+        "step": step,
+        "tokens_seen": int(tokens_seen),
+        "model_config": dataclasses.asdict(model_config),
+        "training_config": dataclasses.asdict(training_config),
+    }
+    if data_state is not None:
+        meta["data_state"] = data_state
+    return meta
+
+
+def _commit_two_phase(
+    checkpoint_dir: str,
+    path: str,
+    snapshot: _HostShardSnapshot,
+    *,
+    step: int,
+    model_config: GPTConfig,
+    training_config: TrainingConfig,
+    tokens_seen: int,
+    data_state: Optional[dict],
+    keep_last_n: int,
+    host: int,
+    world: int,
+    simulated: bool,
+) -> None:
+    """Multi-process two-phase commit (one call per process).
+
+    Phase 1: write this host's shards, then its atomic DONE marker.
+    Phase 2: host 0 waits (bounded, filesystem-only) for all ``world``
+    markers and writes meta.json last; other hosts wait (bounded) for meta
+    so a ``wait=True`` save is durable on every host when it returns. The
+    ``kill_in_save`` fault fires between marker and meta — dying there
+    leaves a meta-less tree every scan ignores, the same crash contract as
+    the single-process path.
+
+    ``simulated`` (injected process seams, one real process playing several
+    hosts sequentially) skips the cross-host waits except host 0's marker
+    check, which is then an instant all-present assertion — run host 0
+    last.
+    """
+    _write_host_shards(path, snapshot, host=host, world=world)
+    _mark_host_done(path, host=host, world=world)
+    if faults.fire("kill_in_save", step):
+        # Injected crash in the window where this host's shards and marker
+        # are durable but meta is not: the checkpoint stays invisible to
+        # every scan — exactly what a real mid-commit host death leaves.
+        faults.kill()
+    if host == 0:
+        _await_commit(
+            path,
+            lambda: _markers_complete(path, world),
+            what=f"{world} host DONE markers",
+            timeout_s=1.0 if simulated else None,
+        )
+        _write_meta(path, dict(_meta_dict(
+            step=step, model_config=model_config,
+            training_config=training_config, tokens_seen=tokens_seen,
+            data_state=data_state,
+        ), format=HOST_SHARDS_FORMAT, shard_world=world))
+        if faults.fire("truncate_meta", step):
+            faults.truncate_file(os.path.join(path, "meta.json"))
+        if faults.fire("corrupt_shard", step):
+            _corrupt_some_shard(path)
+        if keep_last_n > 0:
+            # Non-collective GC: host 0 deletes alone (sync=False) — this
+            # may run on the async writer thread where a jax barrier would
+            # race the main thread's step collectives.
+            gc_checkpoints(checkpoint_dir, keep_last_n, sync=False)
+    elif not simulated:
+        _await_commit(
+            path,
+            lambda: os.path.exists(os.path.join(path, "meta.json")),
+            what="meta.json from host 0",
+        )
+
+
+_SYNC_FALLBACK_WARNED = False
+
+
+def warn_sync_fallback(reason: str) -> bool:
+    """One-time (per process) warning that an async save degraded to the
+    synchronous path, so the full save cost lands on the step critical path.
+    Returns True when the warning was emitted, False when already warned —
+    the cost itself shows up under ``checkpoint_save`` in the goodput
+    ledger, which is exactly where callers attribute the blocking
+    ``AsyncSaver.save()`` call."""
+    global _SYNC_FALLBACK_WARNED
+    if _SYNC_FALLBACK_WARNED:
+        return False
+    _SYNC_FALLBACK_WARNED = True
+    print(
+        f"WARNING: async checkpointing degraded to a synchronous save "
+        f"({reason}); save cost is on the step critical path and attributed "
+        f"to checkpoint_save in the goodput ledger",
+        file=sys.stderr, flush=True,
+    )
+    return True
 
 
 class AsyncSaver:
@@ -275,9 +711,13 @@ class AsyncSaver:
     ``kill_in_save`` (``os._exit``) or a real SIGKILL dies exactly like the
     sync path — mid-commit, meta unwritten.
 
-    Multi-process runs fall back to the synchronous path: the host snapshot
-    can only see addressable shards, and cross-host barriers from a
-    background thread would race the main thread's collectives.
+    Multi-process runs stay async too: the snapshot captures this process's
+    *addressable* shards and the writer thread runs the two-phase commit,
+    whose commit barrier is pure filesystem polling — no jax collectives
+    that could race the main thread's step collectives (the reason the old
+    implementation degraded to synchronous saves at ``process_count > 1``).
+    A defensive synchronous fallback remains for snapshot failures, behind
+    a one-time warning so the degradation is visible.
     """
 
     def __init__(self) -> None:
@@ -289,14 +729,21 @@ class AsyncSaver:
     def in_flight(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
-    def wait(self) -> Optional[str]:
+    def wait(self, timeout: Optional[float] = None) -> Optional[str]:
         """Drain the in-flight commit (if any); returns its path. Re-raises
         a writer-thread failure here, on the step loop's thread, so a bad
         disk surfaces as a crash-with-traceback instead of silent loss of
-        every subsequent checkpoint."""
+        every subsequent checkpoint.
+
+        ``timeout`` (seconds) bounds the drain — the ``--preemption_grace_s``
+        path must not let one slow commit eat the whole grace window. On
+        timeout, returns None with the commit still in flight (the daemon
+        writer dies with the process, leaving the usual meta-less tree)."""
         t = self._thread
         if t is not None:
-            t.join()
+            t.join(timeout)
+            if t.is_alive():
+                return None
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
@@ -317,21 +764,31 @@ class AsyncSaver:
         """Snapshot ``state`` to host and schedule the commit; returns the
         checkpoint path (which is complete only once the commit lands —
         ``wait()`` to require it)."""
-        if jax.process_count() > 1:
-            return save_checkpoint(
-                checkpoint_dir, state,
-                model_config=model_config, training_config=training_config,
-                tokens_seen=tokens_seen, data_state=data_state,
-                keep_last_n=keep_last_n,
-            )
         self.wait()
         if getattr(state, "params_c", None) is not None:
             state = state.replace(params_c=None)
         # The snapshot: blocks until every pending step that writes into
         # this state has finished and the bytes are host-side. This is the
         # whole synchronous cost of an async save.
-        snapshot = jax.device_get(state)
-        step = int(snapshot.step)
+        if jax.process_count() > 1:
+            try:
+                snapshot = host_shard_snapshot(state)
+                step = int(jax.device_get(state.step))
+            except Exception as e:
+                # Defensive only: an addressable-shard snapshot failing is
+                # unexpected, but losing async-ness silently was the old
+                # behavior this PR removes — degrade loudly instead.
+                warn_sync_fallback(f"{type(e).__name__}: {e}")
+                return save_checkpoint(
+                    checkpoint_dir, state,
+                    model_config=model_config,
+                    training_config=training_config,
+                    tokens_seen=tokens_seen, data_state=data_state,
+                    keep_last_n=keep_last_n,
+                )
+        else:
+            snapshot = jax.device_get(state)
+            step = int(snapshot.step)
         path = step_dir(checkpoint_dir, step)
 
         def _commit() -> None:
@@ -355,20 +812,139 @@ class AsyncSaver:
 
 
 def _corrupt_some_shard(path: str) -> None:
-    """Byte-flip every file under <path>/state — the injected version of
-    storage corruption (driven by the corrupt_shard fault). All files, not
-    a sample: tensorstore does not checksum every byte it reads back, so
+    """Byte-flip every file under <path>/state (orbax layout) and
+    <path>/shards (host_shards layout) — the injected version of storage
+    corruption (driven by the corrupt_shard fault). All files, not a
+    sample: tensorstore does not checksum every byte it reads back, so
     flipping one data chunk can restore "successfully" as garbage — the
     fault must deterministically fail the restore for the quarantine path
-    to be testable."""
-    for root, _, names in os.walk(os.path.join(path, "state")):
-        for name in names:
-            faults.corrupt_file(os.path.join(root, name))
+    to be testable. (npz IS integrity-checked: a flipped byte fails the
+    zip CRC on load, which is the deterministic failure we need.)"""
+    for sub in ("state", _SHARDS_SUBDIR):
+        for root, _, names in os.walk(os.path.join(path, sub)):
+            for name in names:
+                faults.corrupt_file(os.path.join(root, name))
 
 
 def load_meta(path: str) -> dict:
     with open(os.path.join(path, "meta.json")) as f:
         return json.load(f)
+
+
+def _assemble_host_shards(
+    path: str,
+    abstract,
+    *,
+    expected_world: Optional[int] = None,
+    key_prefix: str = "",
+):
+    """Reassemble a host_shards checkpoint onto ``abstract``'s shardings.
+
+    Reads every host's manifest + npz, stitches the global numpy array per
+    leaf key, and builds jax arrays via ``make_array_from_callback`` — so a
+    checkpoint written by mesh A restores onto mesh B with a different
+    process count or ``data x fsdp`` factorization (every host file is
+    visible on the shared checkpoint filesystem). Raises ValueError on
+    missing host files or leaf keys (→ the quarantine/fallback path in
+    ``restore_latest``); a flipped byte fails the npz CRC the same way.
+
+    ``key_prefix`` maps ``abstract``'s leaf paths into the saved TrainState
+    key space (e.g. ``.params`` when ``abstract`` is the bare params tree).
+    """
+    sdir = os.path.join(path, _SHARDS_SUBDIR)
+    try:
+        manifests = sorted(
+            n for n in os.listdir(sdir)
+            if n.startswith("host") and n.endswith(".json")
+        )
+    except OSError as e:
+        raise ValueError(f"unreadable shards dir {sdir}: {e}")
+    if expected_world is not None and len(manifests) < expected_world:
+        raise ValueError(
+            f"host_shards checkpoint {path} incomplete: "
+            f"{len(manifests)}/{expected_world} host manifests"
+        )
+    globals_np: Dict[str, np.ndarray] = {}
+    for man_name in manifests:
+        with open(os.path.join(sdir, man_name)) as f:
+            manifest = json.load(f)
+        npz_name = man_name[:-len(".json")] + ".npz"
+        with np.load(os.path.join(sdir, npz_name)) as data:
+            for leaf in manifest["leaves"]:
+                dtype = _resolve_dtype(leaf["dtype"])
+                shape = tuple(leaf["global_shape"])
+                buf = globals_np.get(leaf["key"])
+                if buf is None:
+                    buf = np.zeros(shape, dtype=dtype)
+                    globals_np[leaf["key"]] = buf
+                for sh in leaf["shards"]:
+                    arr = np.frombuffer(
+                        data[sh["name"]].tobytes(), dtype=dtype
+                    ).reshape(sh["shape"])
+                    idx = tuple(
+                        slice(st, st + ln)
+                        for st, ln in zip(sh["start"], sh["shape"])
+                    )
+                    buf[idx] = arr
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+    out = []
+    for key_path, s in leaves:
+        key = key_prefix + jax.tree_util.keystr(key_path)
+        if key not in globals_np:
+            raise ValueError(
+                f"host_shards checkpoint {path} is missing leaf {key!r}"
+            )
+        buf = globals_np[key]
+        if tuple(buf.shape) != tuple(s.shape):
+            raise ValueError(
+                f"host_shards leaf {key!r} has shape {buf.shape}, "
+                f"expected {s.shape}"
+            )
+        if buf.dtype != s.dtype:
+            buf = buf.astype(s.dtype)
+        out.append(jax.make_array_from_callback(
+            tuple(s.shape), s.sharding, lambda idx, b=buf: b[idx]
+        ))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def remap_data_state(
+    data_state: Optional[dict],
+    *,
+    new_global_batch_size: int,
+    new_feed_world: Optional[int] = None,
+) -> Tuple[Optional[dict], int]:
+    """Remap a persisted loader cursor onto a resized run; returns
+    ``(new_state, replayed_sequences)``.
+
+    The cursor's stream position is ``batch_index * global_batch_size``
+    sequences consumed (loader sharding reconstructs each rank's slice from
+    the global position, so a changed ``feed_world`` alone needs no index
+    change). When the global batch size differs, the index floor-divides
+    onto the new batch granularity::
+
+        new_index = (batch_index * old_gbs) // new_gbs
+
+    The flooring means up to one new-sized batch of already-seen sequences
+    replays — the documented **at-least-once** window, at batch
+    granularity; sequences are never skipped. Exact for the dummy and
+    map-style text loaders (their global order is independent of the feed
+    world); best-effort at the same granularity for the streaming loader,
+    whose line-modulo shards re-partition with the feed world.
+    """
+    if data_state is None:
+        return None, 0
+    st = dict(data_state)
+    if new_feed_world is not None:
+        st["feed_world"] = int(new_feed_world)
+    old_gbs = st.get("global_batch_size")
+    st["global_batch_size"] = int(new_global_batch_size)
+    if not old_gbs or int(old_gbs) == int(new_global_batch_size):
+        return st, 0
+    consumed = int(st.get("batch_index", 0)) * int(old_gbs)
+    new_index = consumed // int(new_global_batch_size)
+    st["batch_index"] = new_index
+    return st, consumed - new_index * int(new_global_batch_size)
 
 
 def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
@@ -446,7 +1022,16 @@ def restore_checkpoint(path: str, trainer) -> Tuple[Any, dict]:
         shapes,
         shardings,
     )
-    state = ocp.StandardCheckpointer().restore(os.path.join(path, "state"), abstract)
+    if meta.get("format") == HOST_SHARDS_FORMAT:
+        # Two-phase multi-process checkpoint: reassemble the global arrays
+        # from every host's shard file and place onto this trainer's mesh —
+        # the saved and restoring process counts are fully decoupled.
+        state = _assemble_host_shards(
+            path, abstract, expected_world=meta.get("shard_world")
+        )
+    else:
+        state = ocp.StandardCheckpointer().restore(
+            os.path.join(path, "state"), abstract)
     return trainer.with_params_c(state), meta
 
 
@@ -509,6 +1094,14 @@ def restore_params(path: str):
     abstract = jax.tree_util.tree_map(
         lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding), shapes
     )
+    if meta.get("format") == HOST_SHARDS_FORMAT:
+        # The saved keys are TrainState paths; the abstract tree here is the
+        # bare params dict — bridge with the ".params" attribute prefix.
+        params = _assemble_host_shards(
+            path, abstract, expected_world=meta.get("shard_world"),
+            key_prefix=".params",
+        )
+        return params, config
     # Partial restore: only the params subtree is read — an xl inference load
     # must not pull the (2x param-sized) Adam moments off disk.
     try:

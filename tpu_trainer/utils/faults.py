@@ -20,6 +20,20 @@ programmatically (tests) or from the ``--inject_fault`` debug flag:
   right after it is written (a torn metadata write).
 - ``corrupt_shard@N`` — flip bytes in a state shard of the step-N
   checkpoint after the save completes (silent storage corruption).
+- ``sigterm@N``       — deliver a real SIGTERM to this process at the top
+  of step N (a preemption notice that DID arrive; exercises the
+  ``--preemption_grace_s`` drain-and-final-checkpoint path through the
+  actual signal handler).
+- ``kill_host@N``     — chaos lane: hard-kill one chosen process of a
+  multi-process run at step N (default: the highest rank; override with
+  ``TPU_TRAINER_FAULT_HOST``). Other ranks keep running — the run
+  supervisor must detect the death and reform the mesh.
+- ``hang_host@N``     — chaos lane: the chosen process stops heartbeating
+  at step N *without exiting* (a wedged host): only the supervisor's
+  heartbeat timeout can catch it.
+
+The host-targeted kinds fire (consume) on every rank at step N but act
+only on :func:`target_host`'s rank, so all ranks' plans stay in lockstep.
 
 Each fault is one-shot: it fires at its step and is consumed, so a run that
 rolls back or resumes past the step does not re-trip it — which is exactly
@@ -41,7 +55,7 @@ from typing import List, Optional, Tuple
 
 KINDS = frozenset(
     {"nan_loss", "loss_spike", "kill", "kill_in_save", "truncate_meta",
-     "corrupt_shard"}
+     "corrupt_shard", "sigterm", "kill_host", "hang_host"}
 )
 
 # Exit code for injected kills: mimics SIGKILL's 128+9, the way a preempted
@@ -129,6 +143,20 @@ def plan(spec_or_plan):
 def fire(kind: str, step: int) -> bool:
     """Check-and-consume against the installed plan; no-op without one."""
     return _active is not None and _active.fire(kind, step)
+
+
+def target_host(process_count: int) -> int:
+    """Which rank the host-targeted chaos faults (``kill_host``,
+    ``hang_host``) act on: ``TPU_TRAINER_FAULT_HOST`` or the highest rank —
+    deliberately non-zero by default, so the dying host is never the one
+    that writes meta.json (killing host 0 is a different, stricter drill
+    the env override enables). Returns -1 (matches no rank) when the run
+    has a single process: there is no "non-zero process" to lose, and the
+    supervisor's restarted shrunk run re-arms the same ``--inject_fault``
+    spec — the fault must not kill the recovery it exists to test."""
+    if process_count < 2:
+        return -1
+    return int(os.environ.get("TPU_TRAINER_FAULT_HOST", process_count - 1))
 
 
 def kill(exit_code: int = KILL_EXIT_CODE) -> None:

@@ -133,3 +133,94 @@ def _format_exc(exc: Optional[BaseException]) -> Optional[dict]:
         "traceback": "".join(traceback.format_exception(
             type(exc), exc, exc.__traceback__)),
     }
+
+
+class HeartbeatWriter:
+    """Per-host liveness beats for the elastic run supervisor.
+
+    One JSONL file per host (``heartbeat_host{k}.jsonl`` — per-host files,
+    so concurrent writers never interleave), one line per training step::
+
+        {"kind": "heartbeat", "host": k, "pid": ..., "step": n,
+         "unix": t, "schema_version": ...}
+
+    The supervisor (``training/elastic.py``) reads only the tail: a host
+    whose newest beat is older than the heartbeat timeout is declared hung
+    even though its process is still alive — the failure mode exit codes
+    cannot catch. ``stop()`` freezes the stream without killing the process
+    (what the ``hang_host`` chaos fault drives).
+
+    Beats share the flight-recorder dump directory and record shape (same
+    schema_version), so a recovery timeline reads straight out of the run
+    dir: heartbeats flatline -> supervisor death record -> restart beats.
+    ``min_interval_s`` throttles beat *writes* (a beat arriving inside the
+    window is dropped); 0 writes every step.
+    """
+
+    def __init__(self, directory: str, *, host: int,
+                 min_interval_s: float = 0.0,
+                 recorder: Optional[FlightRecorder] = None):
+        self.host = int(host)
+        self.min_interval_s = float(min_interval_s)
+        self.path = os.path.join(
+            directory, f"heartbeat_host{self.host:05d}.jsonl")
+        self._recorder = recorder
+        self._stopped = False
+        self._last_write = 0.0
+        os.makedirs(directory, exist_ok=True)
+
+    def stop(self) -> None:
+        """Freeze the beat stream (the hang_host fault): the process keeps
+        running but looks dead to the supervisor's staleness check."""
+        self._stopped = True
+
+    def beat(self, step: int) -> None:
+        if self._stopped:
+            return
+        now = time.time()
+        if self.min_interval_s and now - self._last_write < self.min_interval_s:
+            return
+        self._last_write = now
+        record = {
+            "kind": "heartbeat",
+            "schema_version": SCHEMA_VERSION,
+            "host": self.host,
+            "pid": os.getpid(),
+            "step": int(step),
+            "unix": now,
+        }
+        if self._recorder is not None:
+            self._recorder.observe(record)
+        try:
+            with open(self.path, "a") as fh:
+                fh.write(json.dumps(record) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError:
+            # Liveness reporting must never kill the run it reports on; a
+            # beat lost to a transient FS error just looks like one slow
+            # step to the supervisor.
+            pass
+
+
+def read_heartbeat(directory: str, host: int) -> Optional[dict]:
+    """Newest beat of ``host``'s stream, or None before its first beat.
+    Tail-read only — beat files grow unboundedly during long runs and the
+    supervisor polls this every few hundred ms."""
+    path = os.path.join(directory, f"heartbeat_host{host:05d}.jsonl")
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - 4096))
+            lines = fh.read().splitlines()
+    except OSError:
+        return None
+    for raw in reversed(lines):
+        try:
+            rec = json.loads(raw)
+        except ValueError:
+            continue  # torn tail line mid-write
+        if isinstance(rec, dict) and rec.get("kind") == "heartbeat":
+            return rec
+    return None

@@ -312,6 +312,11 @@ class GoodputLedger:
         "checkpoint_commit_wait",
         "checkpoint_restore",
         "rollback_replay",
+        # Elastic recovery: host-death detection -> first post-restart step,
+        # accumulated per restart. Tracked by the run supervisor
+        # (training/elastic.py) — a single trainer process can't see its own
+        # death — and reported from the supervisor's own ledger/JSONL.
+        "recovery",
     )
 
     def __init__(self, clock=time.perf_counter):
