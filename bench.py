@@ -116,6 +116,15 @@ def _build_parser():
                         "comms_model) as schema-stamped JSONL here and run "
                         "tpu_trainer.tools.analyze over it (report on "
                         "stderr); default: a temp file")
+    p.add_argument("--packed", action="store_true",
+                   help="packed-vs-padded A/B: first-fit sequence packing "
+                        "vs pad-to-seq over the same synthetic ragged "
+                        "corpus, through the identical segment-aware train "
+                        "step; reports effective (non-pad) tok/s per lane")
+    p.add_argument("--mean-doc-len", "--mean_doc_len", type=int,
+                   dest="mean_doc_len", default=None,
+                   help="--packed: mean synthetic document length "
+                        "(default seq_len // 4)")
     p.add_argument("--table", action="store_true",
                    help="run the method x chips scaling table")
     p.add_argument("--update-results", action="store_true",
@@ -455,6 +464,149 @@ def analyze_run_jsonl(path: str) -> None:
         print(f"bench: {line}", file=sys.stderr)
 
 
+def run_packed(args, mesh_cfg):
+    """Packed-vs-padded effective-throughput A/B (``--packed``).
+
+    Both lanes bin the SAME deterministic synthetic ragged corpus
+    (``data/packing.synthetic_documents``) into ``[rows, seq, 2]`` batches —
+    first-fit packing vs one-padded-document-per-row — and run the identical
+    segment-aware train step (one compile, shared shapes), so raw tok/s is
+    ~equal and the effective (non-pad) tok/s ratio isolates padding waste:
+    ~seq/mean_doc_len upper bound, the packing headroom.
+    """
+    import jax  # noqa: F401  (platform init side effect)
+
+    from tpu_trainer.data.packing import (PackedDataLoader,
+                                          synthetic_documents)
+    from tpu_trainer.models.config import GPTConfig
+    from tpu_trainer.parallel.mesh import make_mesh
+    from tpu_trainer.training.config import TrainingConfig
+    from tpu_trainer.training.trainer import ParallelConfig, Trainer
+
+    seq_len = args.seq_len
+    mesh = make_mesh(mesh_cfg)
+    common = dict(
+        max_seq_len=seq_len,
+        use_flash_attention=bool(args.flash),
+        gradient_checkpointing=_remat(args),
+        dropout=0.1,
+        attention_dropout=0.1,
+    )
+    if args.model_size == "tiny":
+        model_config = GPTConfig(vocab_size=256, hidden_size=64,
+                                 num_layers=2, num_heads=4, **common)
+    else:
+        model_config = GPTConfig.preset(args.model_size, **common)
+    training_config = TrainingConfig(
+        batch_size=args.batch_size,
+        max_seq_len=seq_len,
+        gradient_accumulation_steps=args.accum,
+        mixed_precision="bf16",
+        log_interval=10**9,
+    )
+    trainer = Trainer(model_config, training_config,
+                      ParallelConfig(mesh_cfg, args.strategy or "replicated"),
+                      mesh=mesh)
+    rows = args.batch_size * args.accum * trainer.dp_size \
+        // trainer.process_count
+    mean_len = args.mean_doc_len or max(8, seq_len // 4)
+    lanes = {}
+    for lane, pack in (("packed", True), ("padded", False)):
+        # Corpus sized so one pass covers warmup + all windows with slack;
+        # the cycling iterator below makes exhaustion a non-event anyway.
+        per_row = max(1, seq_len // mean_len) if pack else 1
+        total = (3 * args.steps + 4) * rows * (per_row + 2)
+        loader = PackedDataLoader(
+            lambda n=total: synthetic_documents(
+                n, mean_len, model_config.vocab_size, seed=17),
+            rows, seq_len, pack=pack, seed=17,
+        )
+
+        def cycle(ld=loader):
+            while True:
+                yield from ld
+
+        it = cycle()
+        state = trainer.init_state()
+        for _ in range(2):  # warmup: compile (first lane) + stabilize
+            state, metrics = trainer.train_step(state, next(it))
+        float(metrics["loss"])
+        window_elapsed = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, metrics = trainer.train_step(state, next(it))
+            float(metrics["loss"])  # end-of-window device sync
+            window_elapsed.append(time.perf_counter() - t0)
+        elapsed = min(window_elapsed)
+        tok_per_sec = args.steps * trainer.tokens_per_step / elapsed
+        frac = loader.non_pad_frac
+        lanes[lane] = {
+            "tok_per_sec": round(tok_per_sec, 1),
+            "non_pad_frac": round(frac, 4),
+            "effective_tok_per_sec": round(tok_per_sec * frac, 1),
+            "window_elapsed_s": [round(w, 3) for w in window_elapsed],
+        }
+    speedup = (lanes["packed"]["effective_tok_per_sec"]
+               / max(lanes["padded"]["effective_tok_per_sec"], 1e-9))
+    return {
+        "metric": "packed_effective_tok_per_sec",
+        "value": lanes["packed"]["effective_tok_per_sec"],
+        "unit": "tok/s",
+        "packed": lanes["packed"],
+        "padded": lanes["padded"],
+        "effective_speedup": round(speedup, 2),
+        "model_size": args.model_size,
+        "batch_size": args.batch_size,
+        "seq_len": seq_len,
+        "mean_doc_len": mean_len,
+        "steps": args.steps,
+        "platform": next(iter(mesh.devices.flat)).platform,
+        "n_chips": mesh.size,
+    }
+
+
+_PACKING_START = "<!-- packing-table:start -->"
+_PACKING_END = "<!-- packing-table:end -->"
+
+
+def update_packing_md(result) -> None:
+    """Splice the --packed A/B into benchmarks/results.md (own marker block,
+    same mechanism as the scaling table)."""
+    header = (
+        f"Measured by `python bench.py --packed` — {result['model_size']}, "
+        f"batch {result['batch_size']}/shard, seq {result['seq_len']}, "
+        f"mean doc len {result['mean_doc_len']}, platform "
+        f"{result['platform']} ({time.strftime('%Y-%m-%d')}).\n\n"
+    )
+    lines = [
+        "| Lane | tok/s | non-pad frac | effective tok/s |",
+        "|---|---|---|---|",
+    ]
+    for lane in ("packed", "padded"):
+        r = result[lane]
+        lines.append(
+            f"| {lane} | {r['tok_per_sec']:,.0f} | {r['non_pad_frac']:.3f} "
+            f"| {r['effective_tok_per_sec']:,.0f} |"
+        )
+    table = "\n".join(lines) + (
+        f"\n\nEffective-throughput speedup (packed / padded): "
+        f"**{result['effective_speedup']:.2f}x**"
+    )
+    block = f"{_PACKING_START}\n{header}{table}\n{_PACKING_END}"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if _PACKING_START in text:
+        pre = text.split(_PACKING_START)[0]
+        post = text.split(_PACKING_END)[1]
+        text = pre + block + post
+    else:
+        text += "\n## Sequence packing\n\n" + block + "\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote packing table to {_RESULTS_MD}", file=sys.stderr)
+
+
 def _chip_counts(n: int):
     c, out = 1, []
     while c <= n:
@@ -597,6 +749,12 @@ def main() -> None:
         tensor=args.mesh_tensor,
         stage=args.mesh_stage,
     )
+    if args.packed:
+        result = run_packed(args, mesh_cfg)
+        print(json.dumps(result))
+        if args.update_results:
+            update_packing_md(result)
+        return
     detail = run_bench(
         model_size=args.model_size, batch_size=args.batch_size,
         seq_len=args.seq_len, steps=args.steps, accum=args.accum,

@@ -1,0 +1,443 @@
+"""Sequence packing + weighted mixture data layer (fast lane).
+
+Covers the pure-Python/numpy side of the packing PR: first-fit binning
+invariants, the packed loader's streaming cursor, the mixture's
+deterministic choice sequence and its two resume contracts (exact resume
+per PR-1, sub-cursor re-derivation after an elastic remap per PR-7), the
+cross-document loss-leak segment derivation in the streaming text dataset,
+and the telemetry/analyzer surfaces that report packing efficiency. No
+model compiles here — kernel parity lives in ``test_flash.py`` (slow lane).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_trainer.data.mixture import (
+    MixtureDataLoader,
+    choose_source,
+    source_counts,
+)
+from tpu_trainer.data.packing import (
+    PackedDataLoader,
+    pack_documents,
+    pad_documents,
+    synthetic_documents,
+)
+
+SEQ = 64
+VOCAB = 97
+
+
+def _docs(n=60, mean=20, seed=3):
+    return list(synthetic_documents(n, mean, VOCAB, seed=seed))
+
+
+class TestPackDocuments:
+    def test_row_format_and_token_conservation(self):
+        docs = _docs()
+        rows = list(pack_documents(docs, SEQ))
+        for row in rows:
+            assert row.shape == (SEQ, 2) and row.dtype == np.int32
+            # Pad positions carry token 0 / segment 0 and only trail data.
+            pad = row[:, 1] == 0
+            assert (row[pad, 0] == 0).all()
+            if pad.any():
+                first_pad = int(np.argmax(pad))
+                assert pad[first_pad:].all()
+        # Every document token comes out exactly once (packing reorders
+        # rows, never drops or duplicates data).
+        fed = sorted(t for d in docs for t in d)
+        got = sorted(
+            int(t) for row in rows for t in row[row[:, 1] != 0, 0]
+        )
+        assert fed == got
+
+    def test_segments_contiguous_from_one(self):
+        for row in pack_documents(_docs(), SEQ):
+            segs = row[row[:, 1] != 0, 1]
+            uniq = np.unique(segs)
+            assert uniq[0] == 1
+            assert (uniq == np.arange(1, len(uniq) + 1)).all()
+            # Within a row each document is one contiguous run.
+            changes = int((np.diff(segs) != 0).sum())
+            assert changes == len(uniq) - 1
+
+    def test_deterministic(self):
+        a = list(pack_documents(_docs(), SEQ))
+        b = list(pack_documents(_docs(), SEQ))
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            np.testing.assert_array_equal(ra, rb)
+
+    def test_long_document_splits_at_row_boundaries(self):
+        doc = list(range(1, 2 * SEQ + 10 + 1))
+        rows = list(pack_documents([doc], SEQ))
+        flat = [int(t) for row in rows for t in row[row[:, 1] != 0, 0]]
+        assert flat == doc
+        # The two full-row pieces are emitted as complete rows.
+        assert (rows[0][:, 1] != 0).all() and (rows[1][:, 1] != 0).all()
+
+    def test_max_open_bins_flushes_without_losing_tokens(self):
+        docs = _docs(n=120, mean=40, seed=5)
+        rows = list(pack_documents(docs, SEQ, max_open_bins=1))
+        fed = sorted(t for d in docs for t in d)
+        got = sorted(
+            int(t) for row in rows for t in row[row[:, 1] != 0, 0]
+        )
+        assert fed == got
+
+    def test_packing_beats_padding(self):
+        docs = _docs(n=200, mean=12, seed=7)
+
+        def frac(rows):
+            rows = np.stack(rows)
+            return (rows[..., 1] != 0).mean()
+
+        packed = frac(list(pack_documents(docs, SEQ)))
+        padded = frac(list(pad_documents(docs, SEQ)))
+        assert packed > 0.9
+        assert packed / padded > 1.5
+
+
+class TestPackedDataLoader:
+    def _loader(self, **kw):
+        kw.setdefault("batch_size", 4)
+        kw.setdefault("seq_len", SEQ)
+        return PackedDataLoader(
+            lambda: synthetic_documents(80, 20, VOCAB, seed=11), **kw
+        )
+
+    def test_batch_shape_and_num_batches(self):
+        batches = list(self._loader(num_batches=3))
+        assert len(batches) == 3
+        for b in batches:
+            assert b.shape == (4, SEQ, 2) and b.dtype == np.int32
+
+    def test_resume_is_bit_exact(self):
+        full = list(self._loader())
+        src = self._loader()
+        it = iter(src)
+        for _ in range(3):
+            next(it)
+        state = src.state_dict()
+        assert state["kind"] == "packed" and state["batch_index"] == 3
+
+        resumed = self._loader()
+        resumed.load_state_dict(state)
+        rest = list(resumed)
+        assert len(rest) == len(full) - 3
+        for a, b in zip(rest, full[3:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_kind_mismatch_rejected(self):
+        loader = self._loader()
+        with pytest.raises(ValueError, match="packed"):
+            loader.load_state_dict({"kind": "dummy", "epoch": 0,
+                                    "batch_index": 1})
+
+    def test_non_pad_frac_tracks_yielded_batches(self):
+        packed = self._loader()
+        list(packed)
+        padded = self._loader(pack=False)
+        list(padded)
+        assert 0.9 < packed.non_pad_frac <= 1.0
+        assert packed.non_pad_frac / padded.non_pad_frac > 1.5
+        assert 0.0 < packed.last_non_pad_frac <= 1.0
+
+
+class TestMixture:
+    def _sources(self):
+        # Distinct seeds make the two sources' batches distinguishable, so
+        # array equality below also checks the *choice* sequence matched.
+        return {
+            "a": PackedDataLoader(
+                lambda: synthetic_documents(60, 20, VOCAB, seed=21),
+                batch_size=2, seq_len=SEQ),
+            "b": PackedDataLoader(
+                lambda: synthetic_documents(60, 20, VOCAB, seed=22),
+                batch_size=2, seq_len=SEQ),
+        }
+
+    WEIGHTS = {"a": 3.0, "b": 1.0}
+
+    def test_choice_sequence_pure_and_weighted(self):
+        picks = [choose_source(5, i, {"a": 0.75, "b": 0.25})
+                 for i in range(2000)]
+        again = [choose_source(5, i, {"a": 0.75, "b": 0.25})
+                 for i in range(2000)]
+        assert picks == again
+        frac_a = picks.count("a") / len(picks)
+        assert abs(frac_a - 0.75) < 0.05
+        counts = source_counts(5, {"a": 0.75, "b": 0.25}, 2000)
+        assert counts["a"] == picks.count("a")
+        assert counts["b"] == picks.count("b")
+
+    def test_resume_is_bit_exact(self):
+        full = list(MixtureDataLoader(
+            self._sources(), self.WEIGHTS, seed=9, num_batches=16))
+
+        mix = MixtureDataLoader(
+            self._sources(), self.WEIGHTS, seed=9, num_batches=16)
+        it = iter(mix)
+        for _ in range(7):
+            next(it)
+        state = mix.state_dict()
+        assert state["kind"] == "mixture" and state["batch_index"] == 7
+
+        resumed = MixtureDataLoader(
+            self._sources(), self.WEIGHTS, seed=9, num_batches=16)
+        resumed.load_state_dict(state)
+        rest = list(resumed)
+        assert len(rest) == len(full) - 7
+        for a, b in zip(rest, full[7:]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_changed_sources_or_kind_rejected(self):
+        mix = MixtureDataLoader(self._sources(), self.WEIGHTS, seed=9)
+        good = mix.state_dict()
+        with pytest.raises(ValueError, match="kind"):
+            mix.load_state_dict(dict(good, kind="packed"))
+        bad = dict(good)
+        bad["sources"] = {"a": good["sources"]["a"]}
+        with pytest.raises(ValueError, match="sources changed"):
+            mix.load_state_dict(bad)
+
+    def test_elastic_remap_rederives_sub_cursors(self):
+        # PR-7 contract: after remap_data_state floor-divides the top-level
+        # batch_index onto a resized global batch, the checkpointed
+        # per-source cursors are stale; load_state_dict must rebuild them
+        # from source_counts rather than trust the saved values.
+        from tpu_trainer.utils.checkpoint import remap_data_state
+
+        mix = MixtureDataLoader(
+            self._sources(), self.WEIGHTS, seed=9, num_batches=32)
+        it = iter(mix)
+        for _ in range(7):
+            next(it)
+        state = mix.state_dict()
+        state["global_batch_size"] = 8  # stamped by the trainer on save
+
+        remapped, replayed = remap_data_state(
+            state, new_global_batch_size=4)
+        assert remapped["batch_index"] == 14 and replayed == 0
+        # Sub-cursors pass through untouched (and are now inconsistent
+        # with the remapped top index).
+        assert remapped["sources"] == state["sources"]
+
+        fresh = MixtureDataLoader(
+            self._sources(), self.WEIGHTS, seed=9, num_batches=32)
+        fresh.load_state_dict(remapped)
+        counts = source_counts(9, fresh.weights, 14)
+        for name, src in fresh.sources.items():
+            assert src.state_dict()["batch_index"] == counts[name], name
+
+    def test_non_pad_frac_weighted_across_sources(self):
+        sources = self._sources()
+        mix = MixtureDataLoader(sources, self.WEIGHTS, seed=9, num_batches=8)
+        list(mix)
+        fracs = {n: s.non_pad_frac for n, s in sources.items()}
+        expected = (0.75 * fracs["a"] + 0.25 * fracs["b"])
+        assert abs(mix.non_pad_frac - expected) < 1e-9
+
+
+class TestTextLeakFix:
+    @pytest.fixture
+    def corpus(self, tmp_path):
+        path = tmp_path / "tiny.txt"
+        path.write_text("\n".join(
+            f"doc {i} " + "x" * (5 + 7 * (i % 4)) for i in range(12)
+        ) + "\n")
+        return str(path)
+
+    def _ds(self, corpus, **kw):
+        from tpu_trainer.data.text import StreamingTextDataset
+
+        return StreamingTextDataset(
+            corpus, seq_len=32, tokenizer_name="byte", **kw
+        )
+
+    def test_masked_stream_adds_segment_channel(self, corpus):
+        plain = list(self._ds(corpus))
+        masked = list(self._ds(corpus, mask_doc_boundaries=True))
+        assert len(plain) == len(masked)
+        eos = self._ds(corpus).tokenizer.eos_token_id
+        for chunk, pair in zip(plain, masked):
+            assert pair.shape == (32, 2)
+            np.testing.assert_array_equal(pair[:, 0], chunk)
+            segs = pair[:, 1]
+            # seg = 1 + number of EOS strictly before the position: starts
+            # at 1, never 0 (no padding in the rolling stream), and
+            # increments exactly after each EOS.
+            assert segs[0] == 1
+            expected = 1 + np.cumsum(
+                np.concatenate([[0], (chunk[:-1] == eos).astype(np.int64)]))
+            np.testing.assert_array_equal(segs, expected)
+
+    def test_segment_target_mask_blocks_boundary_targets(self, corpus):
+        import jax.numpy as jnp
+
+        from tpu_trainer.ops.loss import segment_target_mask
+
+        pair = next(iter(self._ds(corpus, mask_doc_boundaries=True)))
+        segs = jnp.asarray(pair[None, :, 1])
+        mask = np.asarray(segment_target_mask(segs))[0]
+        np_segs = pair[:, 1]
+        # Position t trains iff t+1 stays in the same document: the EOS ->
+        # next-document target (the cross-document leak) must be masked.
+        for t in range(31):
+            assert mask[t] == (1.0 if np_segs[t + 1] == np_segs[t] else 0.0)
+        assert mask[31] == 0.0  # shifted neighbor is the zero pad
+
+    def test_iter_documents_one_per_line_eos_terminated(self, corpus):
+        ds = self._ds(corpus)
+        docs = list(ds.iter_documents())
+        assert len(docs) == 12
+        eos = ds.tokenizer.eos_token_id
+        for doc in docs:
+            assert doc[-1] == eos
+            assert eos not in doc[:-1]
+
+
+class TestTelemetryPacking:
+    def test_goodput_ledger_token_accounting(self):
+        from tpu_trainer.utils.telemetry import GoodputLedger
+
+        t = [0.0]
+        ledger = GoodputLedger(clock=lambda: t[0])
+        ledger.add("step", 2.0)
+        t[0] = 4.0
+        ledger.add_tokens(1000, 800)
+        ledger.add_tokens(500)  # unpacked step: all tokens count
+        rec = ledger.record(final=True)
+        assert rec["tokens"] == 1500
+        assert rec["non_pad_tokens"] == 1300
+        # Token ratio lives OUTSIDE the "*_frac" namespace: goodput
+        # consumers sum every *_frac key as a wall-clock share.
+        assert rec["non_pad_token_ratio"] == pytest.approx(1300 / 1500)
+        assert not any(k == "non_pad_frac" for k in rec)
+        assert rec["effective_tok_per_sec"] == pytest.approx(650.0)
+        assert any("non-pad" in line for line in ledger.summary_lines())
+
+    def test_goodput_record_omits_tokens_when_untracked(self):
+        from tpu_trainer.utils.telemetry import GoodputLedger
+
+        rec = GoodputLedger().record(final=True)
+        assert "tokens" not in rec and "non_pad_token_ratio" not in rec
+
+    def test_metric_logger_emits_effective_rate_only_when_tracked(self):
+        from tpu_trainer.utils.logging import MetricLogger
+
+        logger = MetricLogger(tokens_per_step=1000, log_interval=1,
+                              stdout=False, is_main_process=True)
+        rec = logger.log(0, {"loss": 2.0})
+        assert "non_pad_frac" not in rec
+        assert "effective_tokens_per_sec" not in rec
+
+        logger.non_pad_frac = 0.8
+        rec = logger.log(1, {"loss": 2.0})
+        assert rec["non_pad_frac"] == pytest.approx(0.8)
+        ratio = rec["effective_tokens_per_sec"] / rec["tokens_per_sec"]
+        assert ratio == pytest.approx(0.8, rel=1e-3)
+
+
+def _train_records(non_pad_frac, n=6):
+    recs = []
+    for i in range(n):
+        recs.append({
+            "kind": "train", "schema_version": 1, "step": i,
+            "loss": 2.0 - 0.01 * i, "lr": 1e-3, "grad_norm": 1.0,
+            "tokens_per_sec": 100.0, "elapsed_s": float(i),
+            "non_pad_frac": non_pad_frac,
+            "effective_tokens_per_sec": round(100.0 * non_pad_frac, 1),
+        })
+    recs.append({
+        "kind": "goodput", "schema_version": 1, "final": True,
+        "total_seconds": float(n), "productive_frac": 0.9,
+        "untracked_frac": 0.05, "step_seconds": float(n) * 0.9,
+        "step_frac": 0.9, "tokens": 1000 * n,
+        "non_pad_tokens": int(1000 * n * non_pad_frac),
+        "non_pad_token_ratio": non_pad_frac,
+        "effective_tok_per_sec": 100.0 * non_pad_frac,
+    })
+    return recs
+
+
+class TestAnalyzePacking:
+    def test_summarize_reports_packing(self):
+        from tpu_trainer.tools.analyze import summarize
+
+        report = summarize(_train_records(0.98))
+        pack = report["packing"]
+        assert pack["non_pad_frac"] == pytest.approx(0.98)
+        assert pack["ledger_non_pad_frac"] == pytest.approx(0.98)
+        assert pack["effective_tok_per_sec"]["p50"] == pytest.approx(98.0)
+        # non_pad_frac is a token ratio, not a wall-clock share: it must
+        # stay out of the goodput fractions table.
+        assert "non_pad" not in report.get("goodput", {}).get(
+            "fractions", {})
+
+    def test_compare_gates_absolute_non_pad_regression(self):
+        from tpu_trainer.tools.analyze import compare, summarize
+
+        base = summarize(_train_records(0.98))
+
+        def verdict_for(new_frac, **kw):
+            verdicts = compare(base, summarize(_train_records(new_frac)),
+                               **kw)
+            (v,) = [v for v in verdicts if v["metric"] == "non_pad_frac"]
+            return v
+
+        ok = verdict_for(0.96)
+        assert ok["verdict"] == "PASS" and ok.get("absolute") is True
+        bad = verdict_for(0.90)
+        assert bad["verdict"] == "FAIL"
+        # The tolerance is absolute fraction points, overridable.
+        assert verdict_for(0.90, pack_tol=0.20)["verdict"] == "PASS"
+
+    def test_compare_skips_when_untracked(self):
+        from tpu_trainer.tools.analyze import compare, summarize
+
+        plain = [dict(r) for r in _train_records(0.98)[:-1]]
+        for r in plain:
+            r.pop("non_pad_frac", None)
+            r.pop("effective_tokens_per_sec", None)
+        base = summarize(plain)
+        new = summarize(_train_records(0.98))
+        (v,) = [v for v in compare(base, new)
+                if v["metric"] == "non_pad_frac"]
+        assert v["verdict"] == "SKIP"
+
+
+class TestCliWiring:
+    def test_parse_mixture_spec(self):
+        from tpu_trainer.training.cli import parse_mixture_spec
+
+        spec = parse_mixture_spec(
+            "dummy:1,tinystories:3:/data/ts.txt")
+        assert spec == {"dummy": (1.0, None),
+                        "tinystories": (3.0, "/data/ts.txt")}
+        for bad in ("dummy", "mystery:1", "dummy:heavy",
+                    "dummy:1,dummy:2"):
+            with pytest.raises(SystemExit):
+                parse_mixture_spec(bad)
+
+    def test_packed_synthetic_loader_strides_ranks(self):
+        from tpu_trainer.training.cli import _packed_synthetic_loader
+
+        def make(rank):
+            return _packed_synthetic_loader(
+                rows=1, seq_len=SEQ, vocab_size=VOCAB, num_batches=4,
+                seed=0, feed_rank=rank, feed_world=2, max_open_bins=8)
+
+        b0, b1 = list(make(0)), list(make(1))
+        assert len(b0) == len(b1) == 4
+        for b in b0 + b1:
+            assert b.shape == (1, SEQ, 2) and b.dtype == np.int32
+        # Ranks pack disjoint document streams (strided), so their rows
+        # differ; each rank's stream is deterministic across re-creation.
+        assert any(not np.array_equal(a, b) for a, b in zip(b0, b1))
+        again = list(make(1))
+        for a, b in zip(b1, again):
+            np.testing.assert_array_equal(a, b)

@@ -240,6 +240,7 @@ class StreamingTextDataset:
         num_workers: int = 0,
         tokenizer_on_fallback: str = "warn",
         holdout=None,
+        mask_doc_boundaries: bool = False,
     ):
         """``holdout=(role, N)`` carves an eval split out of the stream:
         every N-th line *of each host's shard* (``(line_idx // num_shards)
@@ -263,6 +264,13 @@ class StreamingTextDataset:
             if role not in ("train", "eval") or every < 2:
                 raise ValueError(f"bad holdout {holdout!r}")
         self.holdout = holdout
+        # Cross-document loss-leak fix: with the flag on, each yielded chunk
+        # carries a segment channel ([seq_len, 2]: tokens, segment ids)
+        # derived from the EOS positions inside the window, so attention is
+        # isolated per document and the loss skips targets that would cross
+        # a boundary. Default OFF for bit-compat with runs checkpointed on
+        # the leaky stream (identical batches, identical loss curve).
+        self.mask_doc_boundaries = mask_doc_boundaries
         self.cache = LRUTokenCache(cache_max_tokens)
 
     def _encode(self, line: str) -> List[int]:
@@ -286,6 +294,43 @@ class StreamingTextDataset:
                 yield line_idx, line
 
     def __iter__(self) -> Iterator[np.ndarray]:
+        if not self.mask_doc_boundaries:
+            yield from self._iter_tokens()
+            return
+        eos = self.tokenizer.eos_token_id
+        for chunk in self._iter_tokens():
+            # Document d's positions are those after the (d-1)-th EOS in the
+            # window: seg = 1 + #EOS strictly before. The EOS itself closes
+            # its document, so the boundary target (EOS -> next doc's first
+            # token) gets seg[t+1] != seg[t] and is loss-masked
+            # (ops/loss.segment_target_mask). A doc spanning two windows
+            # restarts at seg 1 in the next window — consistent: the window
+            # is the attention scope. No padding, so no seg-0 positions.
+            segs = 1 + np.cumsum(
+                np.concatenate([[0], (chunk[:-1] == eos).astype(np.int32)])
+            )
+            yield np.stack([chunk, segs.astype(np.int32)], axis=-1)
+
+    def iter_documents(self) -> Iterator[List[int]]:
+        """Per-line token lists (EOS appended) under the same shard/holdout/
+        budget rules as the chunk stream — the document source the packing
+        loader (``data/packing.py``) bins into full rows."""
+        tokens_seen = 0
+        with open_text(self.path) as f:
+            for line_idx, line in self._sharded_lines(f):
+                tokens = self.cache.get(line_idx)
+                if tokens is None:
+                    tokens = self._encode(line)
+                    self.cache.put(line_idx, tokens)
+                if self.max_tokens is not None:
+                    remaining = self.max_tokens - tokens_seen
+                    if remaining <= 0:
+                        return
+                    tokens = tokens[:remaining]
+                tokens_seen += len(tokens)
+                yield tokens
+
+    def _iter_tokens(self) -> Iterator[np.ndarray]:
         if self.num_workers > 0:
             yield from self._iter_parallel()
             return
@@ -524,6 +569,7 @@ def create_text_dataloader(
     tokenizer_on_fallback: str = "warn",
     eval_split: float = 0.0,
     eval_holdout_every: int = 0,
+    mask_doc_boundaries: bool = False,
 ) -> TextDataLoader:
     """Factory shared by the dataset-specific wrappers (reference factory
     signatures: ``tinystories.py:122-134``, ``openwebtext.py:133-145``).
@@ -552,7 +598,8 @@ def create_text_dataloader(
             tokenizer_on_fallback=tokenizer_on_fallback,
         )
         dataset = StreamingTextDataset(
-            path, seq_len, num_workers=num_workers, holdout=holdout, **common
+            path, seq_len, num_workers=num_workers, holdout=holdout,
+            mask_doc_boundaries=mask_doc_boundaries, **common
         )
         if eval_holdout_every:
             eval_ds = StreamingTextDataset(

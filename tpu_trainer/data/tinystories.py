@@ -30,6 +30,7 @@ def create_tinystories_dataloader(
     tokenizer_on_fallback: str = "warn",
     eval_split: float = 0.0,
     eval_holdout_every: int = 0,
+    mask_doc_boundaries: bool = False,
 ) -> TextDataLoader:
     """Reference-parity factory (``tinystories.py:122-161``): ``batch_size``
     is rows per host; yields ``[batch_size, seq_len]`` int32 batches."""
@@ -49,4 +50,5 @@ def create_tinystories_dataloader(
         tokenizer_on_fallback=tokenizer_on_fallback,
         eval_split=eval_split,
         eval_holdout_every=eval_holdout_every,
+        mask_doc_boundaries=mask_doc_boundaries,
     )

@@ -156,7 +156,8 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array, deterministic: bool = True, decode: bool = False
+        self, x: jax.Array, deterministic: bool = True, decode: bool = False,
+        segment_ids: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         b, s, _ = x.shape
@@ -197,6 +198,18 @@ class CausalSelfAttention(nn.Module):
             dropout_rng = self.make_rng("dropout") if needs_rng else None
             manual_ctx = ring.current_manual_context()
             sp_ctx = ring.current_context()
+            if segment_ids is not None and (
+                (manual_ctx is not None
+                 and manual_ctx.mesh.shape[manual_ctx.axis_name] > 1)
+                or (sp_ctx is not None
+                    and sp_ctx.mesh.shape[sp_ctx.axis_name] > 1)
+            ):
+                # The ring paths rotate K/V chunks across the sequence axis;
+                # segment isolation there needs per-chunk segment slices the
+                # ring body does not yet carry.
+                raise NotImplementedError(
+                    "segment_ids are not supported under sequence parallelism"
+                )
             if (manual_ctx is not None
                     and manual_ctx.mesh.shape[manual_ctx.axis_name] > 1):
                 # Already inside a manual region bound to the sequence axis
@@ -237,6 +250,7 @@ class CausalSelfAttention(nn.Module):
                     deterministic=deterministic,
                     dropout_rng=dropout_rng,
                     rope=(cos, sin),
+                    segment_ids=segment_ids,
                 )
             else:
                 q, k = apply_rotary_pos_emb(q, k, cos, sin)
@@ -245,6 +259,7 @@ class CausalSelfAttention(nn.Module):
                     dropout_rate=cfg.attention_dropout,
                     deterministic=deterministic,
                     dropout_rng=dropout_rng,
+                    segment_ids=segment_ids,
                 )
 
         out = out.reshape(b, s, cfg.hidden_size)
@@ -531,11 +546,13 @@ class MLP(nn.Module):
 class TransformerBlock(nn.Module):
     """Pre-norm block with two residuals (reference ``gpt.py:286-316``).
 
-    Written in scan form: ``__call__(carry, _) -> (carry, ys)`` so a single
+    Written in scan form: ``__call__(carry, seg) -> (carry, ys)`` so a single
     traced block is iterated ``num_layers`` times by ``nn.scan``. The carry
     is ``(x, aux)`` — ``aux`` accumulates the MoE load-balance loss across
-    layers (zero for the dense model). ``ys`` is normally None; under an
-    active telemetry capture (utils/telemetry) it is a dict of per-layer
+    layers (zero for the dense model). The second argument is the packed
+    batch's ``segment_ids`` (or None), broadcast to every layer
+    (``in_axes=nn.broadcast`` on the scan). ``ys`` is normally None; under
+    an active telemetry capture (utils/telemetry) it is a dict of per-layer
     activation/router stats, which the scan stacks into ``[num_layers]``
     vectors (the unrolled path stacks them by hand).
     """
@@ -545,13 +562,13 @@ class TransformerBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, carry, _unused=None):
+    def __call__(self, carry, segment_ids=None):
         cfg = self.config
         x, aux = carry
         residual = x
         h = RMSNorm(dtype=cfg.compute_dtype, name="input_layernorm")(x)
         h = CausalSelfAttention(cfg, name="attention")(
-            h, self.deterministic, self.decode
+            h, self.deterministic, self.decode, segment_ids
         )
         attn_out = h
         x = residual + h
@@ -627,12 +644,18 @@ class GPT(nn.Module):
         labels: Optional[jax.Array] = None,
         train: bool = False,
         decode: bool = False,
+        segment_ids: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Optional[jax.Array]]:
         """Forward pass.
 
         ``attention_mask`` is accepted for API parity but — exactly like the
         reference (``gpt.py:203`` passes ``attn_mask=None``; SURVEY.md §2.1 b3)
         — semantics are causal-only.
+
+        ``segment_ids`` ([b, s] int, 0 = padding, documents 1..K) isolates
+        attention within packed documents and masks loss targets that would
+        cross a document boundary. Unsupported under pipeline parallelism
+        and sequence parallelism (NotImplementedError).
 
         Returns ``(logits [b, s, vocab] float32, loss | None)``.
         """
@@ -672,13 +695,22 @@ class GPT(nn.Module):
 
             def run_block(p, carry, rng):
                 rngs = {} if rng is None else {"dropout": rng}
-                return block_mod.apply({"params": p}, carry, rngs=rngs)
+                return block_mod.apply(
+                    {"params": p}, carry, segment_ids, rngs=rngs
+                )
 
             if cfg.gradient_checkpointing:
                 run_block = jax.checkpoint(
                     run_block, prevent_cse=False,
                     policy=policies[cfg.remat_policy],
                 )
+        if segment_ids is not None and stage_n > 1:
+            # The GPipe schedule slices microbatches itself and its 1f1b
+            # variants bypass normal AD; segment plumbing there is a
+            # separate project.
+            raise NotImplementedError(
+                "segment_ids are not supported under pipeline parallelism"
+            )
         if manual_apply and stage_n > 1:
             # Pipeline parallelism: the stacked layers (sharded over `stage`
             # by parallel/sharding.py) run through the GPipe schedule
@@ -755,10 +787,11 @@ class GPT(nn.Module):
                 variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
+                in_axes=nn.broadcast,  # segment_ids: same array every layer
             )
             (x, moe_aux), layer_telem = layers(
                 cfg, deterministic=not train, decode=decode, name="layers"
-            )(carry0, None)
+            )(carry0, segment_ids)
             if layer_telem is not None:
                 telemetry.record("layers", layer_telem)
 
@@ -792,6 +825,7 @@ class GPT(nn.Module):
                     embed.embedding, x, labels,
                     chunk_size=cfg.loss_chunk_size,
                     allow_pallas=cfg.fused_loss_pallas,
+                    segment_ids=segment_ids,
                 )
             elif cfg.remat_lm_head:
                 # Nothing of the [b, s, vocab] softmax survives forward; the
@@ -801,8 +835,11 @@ class GPT(nn.Module):
                 # consumes the loss.)
                 def head_loss(xf):
                     lg = embed.attend(xf).astype(jnp.float32)
-                    return jnp.mean(
-                        optax_softmax_cross_entropy(lg[:, :-1, :], labels[:, 1:])
+                    return _masked_shifted_mean(
+                        optax_softmax_cross_entropy(
+                            lg[:, :-1, :], labels[:, 1:]
+                        ),
+                        segment_ids,
                     )
 
                 loss = jax.checkpoint(
@@ -810,8 +847,9 @@ class GPT(nn.Module):
                     policy=jax.checkpoint_policies.nothing_saveable,
                 )(x)
             else:
-                loss = jnp.mean(
-                    optax_softmax_cross_entropy(logits[:, :-1, :], labels[:, 1:])
+                loss = _masked_shifted_mean(
+                    optax_softmax_cross_entropy(logits[:, :-1, :], labels[:, 1:]),
+                    segment_ids,
                 )
             if cfg.num_experts > 0:
                 # MoE auxiliaries (mean over layers). The layer returns them
@@ -819,6 +857,18 @@ class GPT(nn.Module):
                 # router_z_weight * z-loss (models/moe.py).
                 loss = loss + moe_aux / cfg.num_layers
         return logits, loss
+
+
+def _masked_shifted_mean(ce: jax.Array, segment_ids) -> jax.Array:
+    """Mean of per-position shifted CE ``[b, s-1]``, dropping positions whose
+    next-token target crosses a packed-document boundary (or is padding).
+    With ``segment_ids=None`` this is a plain mean — the unpacked path."""
+    if segment_ids is None:
+        return jnp.mean(ce)
+    from tpu_trainer.ops.loss import segment_target_mask
+
+    m = segment_target_mask(segment_ids)[:, :-1]
+    return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
 def optax_softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:

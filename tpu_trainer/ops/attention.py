@@ -108,19 +108,22 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
         return flash.flash_attention(q, k, v, **kernel_kwargs)
     spec = P(b_spec, None, h_spec, None)
 
-    # Traced values (rng key, rope tables) enter shard_map as explicit
-    # replicated arguments, not closure captures.
+    # Traced values (rng key, rope tables, segment ids) enter shard_map as
+    # explicit arguments, not closure captures. Segment ids shard with the
+    # batch axis like every other per-row operand.
     static_kwargs = dict(kernel_kwargs)
     rng = static_kwargs.pop("dropout_rng")
     rope_tabs = static_kwargs.pop("rope")
+    seg = static_kwargs.pop("segment_ids", None)
     has_rng = rng is not None
     has_rope = rope_tabs is not None
+    has_seg = seg is not None
     extras = (() if not has_rng else (rng,)) + (
         tuple(rope_tabs) if has_rope else ()
-    )
+    ) + ((seg,) if has_seg else ())
     extra_specs = (() if not has_rng else (P(),)) + (
         (P(None, None), P(None, None)) if has_rope else ()
-    )
+    ) + ((P(b_spec, None),) if has_seg else ())
 
     def local(q, k, v, *extra):
         i = 0
@@ -132,8 +135,12 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
             rng_local = jax.random.fold_in(extra[0], coord)
             i = 1
         rope_local = (extra[i], extra[i + 1]) if has_rope else None
+        if has_rope:
+            i += 2
+        seg_local = extra[i] if has_seg else None
         return flash.flash_attention(
-            q, k, v, dropout_rng=rng_local, rope=rope_local, **static_kwargs
+            q, k, v, dropout_rng=rng_local, rope=rope_local,
+            segment_ids=seg_local, **static_kwargs
         )
 
     # Manual only over the axes this wrapper actually shards: other axes
@@ -170,6 +177,13 @@ def _sharded_kernel(q, k, v, mesh, kernel_kwargs):
     return fn(q, k, v, *extras)
 
 
+def segment_mask(segment_ids: jax.Array) -> jax.Array:
+    """Boolean [batch, 1, seq, seq] mask, True where q and k positions share
+    a segment id — the dense form of the kernels' packed-document
+    isolation. Broadcastable against [batch, heads, q, k] score tensors."""
+    return (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
+
+
 def reference_attention(
     q: jax.Array,
     k: jax.Array,
@@ -178,19 +192,24 @@ def reference_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Manual causal attention (reference ``gpt.py:230-234``).
 
     float32 softmax for stability (the reference passes ``dtype=torch.float32``
     to softmax), dropout applied to the attention weights. Accepts grouped
     K/V (``num_kv_heads < num_heads``) by head repetition — the GQA oracle.
+    ``segment_ids`` ([batch, seq] int) additionally restricts attention to
+    same-segment pairs — the dense oracle for the packed flash kernels.
     """
     _, s, h, d = q.shape
     k, v = repeat_kv(k, v, h)
     scale = 1.0 / math.sqrt(d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    mask = causal_mask(s)
-    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(scores.dtype).min)
+    mask = causal_mask(s)[None, None, :, :]
+    if segment_ids is not None:
+        mask = mask & segment_mask(segment_ids)
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_rate > 0.0 and not deterministic:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, weights.shape)
@@ -207,6 +226,7 @@ def flash_attention(
     deterministic: bool = True,
     dropout_rng: Optional[jax.Array] = None,
     rope: Optional[tuple] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Fused causal attention (reference flash path, ``gpt.py:199-206``).
 
@@ -215,7 +235,8 @@ def flash_attention(
     and RoPE fused into the kernel when ``rope=(cos, sin)`` is given.
     Off-TPU, applies rope externally and uses XLA's fused attention, with
     the manual path covering the dropout case (same semantics as the
-    reference's manual branch).
+    reference's manual branch). ``segment_ids`` ([batch, seq] int)
+    isolates attention within packed documents on every path.
     """
     active_dropout = dropout_rate > 0.0 and not deterministic
     interpret = os.environ.get(_INTERPRET_ENV, "0") == "1"
@@ -232,6 +253,7 @@ def flash_attention(
                 dropout_rng=dropout_rng,
                 rope=rope,
                 interpret=interpret,
+                segment_ids=segment_ids,
             )
             mesh = _flash_mesh(q)
             if mesh is not None:
@@ -241,12 +263,13 @@ def flash_attention(
         from tpu_trainer.ops.rope import apply_rotary_pos_emb
 
         q, k = apply_rotary_pos_emb(q, k, rope[0], rope[1])
-    if active_dropout:
+    if active_dropout or segment_ids is not None:
         return reference_attention(
             q, k, v,
-            dropout_rate=dropout_rate,
-            deterministic=deterministic,
+            dropout_rate=dropout_rate if active_dropout else 0.0,
+            deterministic=deterministic and not active_dropout,
             dropout_rng=dropout_rng,
+            segment_ids=segment_ids,
         )
     # jax.nn.dot_product_attention handles grouped K/V natively (K heads
     # dividing N) — pass the compact tensors straight through.

@@ -76,6 +76,18 @@ DEFAULT_BLOCK_K = 1024
 # block-skipping at 512 measured faster than the single-block layout.
 _BWD_BLOCK = 512
 _NEG_INF = float("-inf")
+# Mask value for SEGMENTED kernel instances. With segment skipping a
+# q-row's first *processed* k-block can be fully masked (every column in
+# another segment), and -inf there would meet the -inf running-max init:
+# exp(-inf - (-inf)) = NaN. A large-finite mask keeps the online softmax
+# NaN-free: the fully-masked block leaves m = -1e30 and garbage (l, acc)
+# that the first genuinely-valid block wipes via alpha = exp(-1e30 - m)
+# = 0, and once m is finite every masked score contributes
+# exp(-1e30 - m) which underflows to exactly 0.0 in f32 — bit-identical
+# to the -inf masking the dense reference uses. Every row attends at
+# least to itself (same segment, causal diff 0), so the diagonal block
+# always lands a finite max.
+_SEG_MASK = -1e30
 _GOLDEN = 0x9E3779B9  # Weyl increment for the per-(batch,head) salt
 
 
@@ -211,6 +223,25 @@ def _unrotate_grad(g, cos, sin):
     return g * cos + rt
 
 
+def _seg_predicates(qseg, kseg):
+    """Block-skip predicates from loaded q/k segment-id slices.
+
+    ``overlap``: some q row *may* share a segment with some k column —
+    the interval test on [min, max]. Sound for arbitrary id layouts
+    (min <= v <= max holds elementwise, so equal ids force overlapping
+    intervals) and exact for the packer's sorted rows; padding-0 tails
+    only over-approximate, which the elementwise mask then corrects.
+    ``uniform``: both blocks are one identical segment end to end, so the
+    block needs no elementwise segment mask at all — the segment
+    analogue of the causal ``full`` predicate.
+    """
+    qf, ql = jnp.min(qseg), jnp.max(qseg)
+    kf, kl = jnp.min(kseg), jnp.max(kseg)
+    overlap = (qf <= kl) & (kf <= ql)
+    uniform = (qf == ql) & (kf == kl) & (qf == kf)
+    return overlap, uniform
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
@@ -218,7 +249,7 @@ def _unrotate_grad(g, cos, sin):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
                 block_k, scale, causal, dropout_rate, fuse_rope, hw_prng,
-                hp):
+                hp, segmented=False):
     # Operands are the model's FOLDED layout, sliced per head *group* by
     # the BlockSpec: q_ref [1, block_q, hp*d] and k_ref/v_ref
     # [1, seq, hp*d] are column slices of [b, s, h*d] arrays. ``hp`` is
@@ -248,7 +279,15 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
     # per layer in the in-model backward. Same residual footprint (the
     # rotated tensors replace the raw ones in the autodiff save).
     if fuse_rope:
-        cos_ref, sin_ref, o_ref, lse_ref, qr_ref, kr_ref, *scrs = rest
+        cos_ref, sin_ref, *rest = rest
+    if segmented:
+        # Segment ids ride along as [1, block_q] (q rows) and [1, seq]
+        # (full k row) int32 blocks; masking/skipping below treats blocks
+        # whose q-range and k-range share no segment exactly like the
+        # causal below-diagonal blocks.
+        qseg_ref, kseg_ref, *rest = rest
+    if fuse_rope:
+        o_ref, lse_ref, qr_ref, kr_ref, *scrs = rest
     else:
         o_ref, lse_ref, *scrs = rest
         qr_ref = kr_ref = None
@@ -259,6 +298,10 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
     iq = pl.program_id(2)
     q_start = iq * block_q
     seed = _seed_from_ref(seed_ref)
+    mask_val = _SEG_MASK if segmented else _NEG_INF
+    if segmented:
+        qseg = qseg_ref[0, :][:, None]        # [bq, 1]
+        kseg_row = kseg_ref[0, :]             # [seq]
     # Hoisted out of the (pl.when-predicated) block bodies: program_id
     # staged inside a predicated body lowers as a plain cond branch in
     # interpret mode, where the primitive has no rule outside the grid
@@ -292,9 +335,14 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
         # scratch round-trips — one straight-line masked softmax per
         # (batch, head). Measured ~33% faster than 512-block streaming on
         # v5e at s=1024 even though the masked upper triangle is computed.
+        valid = None
         if causal:
             diff = (jax.lax.broadcasted_iota(jnp.int32, (block_q, seq), 0)
                     - jax.lax.broadcasted_iota(jnp.int32, (block_q, seq), 1))
+            valid = diff >= 0
+        if segmented:
+            same = qseg == kseg_row[None, :]
+            valid = same if valid is None else valid & same
         for t in range(hp):
             q = load_q(t)
             k = k_ref[0, :, pl.ds(t * d, d)]
@@ -306,8 +354,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            if causal:
-                s = jnp.where(diff >= 0, s, _NEG_INF)
+            if valid is not None:
+                s = jnp.where(valid, s, mask_val)
             m = jnp.max(s, axis=-1, keepdims=True)
             p = jnp.exp(s - m)
             l = jnp.sum(p, axis=-1, keepdims=True)
@@ -352,7 +400,14 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
                 preferred_element_type=jnp.float32,
             )  # [bq, bk] f32 (already scaled via q)
             if masked:
-                s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
+                valid = None
+                if causal:
+                    valid = diff >= k_start - q_start
+                if segmented:
+                    # k_start is a static unroll index: plain value slice.
+                    same = qseg == kseg_row[k_start:k_start + block_k][None, :]
+                    valid = same if valid is None else valid & same
+                s = jnp.where(valid, s, mask_val)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
             alpha = jnp.exp(m - m_new)
@@ -375,18 +430,32 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest,
             )
 
     for ik in range(seq // block_k):
-        if not causal:
+        if not causal and not segmented:
             body(ik, masked=False)
             continue
         k_start = ik * block_k
-        # needed: any (row, col) with row >= col, i.e. the block's last row
-        # reaches its first column. full: every element valid (last column
-        # <= first row). Both predicates depend on the dynamic q_start.
-        needed = q_start + block_q - 1 >= k_start
-        full = q_start >= k_start + block_k - 1
-        pl.when(full)(functools.partial(body, ik, False))
-        pl.when(needed & jnp.logical_not(full))(
-            functools.partial(body, ik, True))
+        # Causal — needed: any (row, col) with row >= col, i.e. the
+        # block's last row reaches its first column. full: every element
+        # valid (last column <= first row). Both predicates depend on the
+        # dynamic q_start. Segments compose the same way: no-overlap
+        # blocks are skipped outright (the generalization of the
+        # below-diagonal skip), and only non-uniform boundary blocks pay
+        # the elementwise mask.
+        if causal:
+            needed = q_start + block_q - 1 >= k_start
+            full = q_start >= k_start + block_k - 1
+        else:
+            needed = full = True
+        if segmented:
+            overlap, uniform = _seg_predicates(
+                qseg, kseg_row[k_start:k_start + block_k])
+            run_full = full & uniform
+            run_masked = needed & overlap & jnp.logical_not(run_full)
+        else:
+            run_full = full
+            run_masked = needed & jnp.logical_not(full)
+        pl.when(run_full)(functools.partial(body, ik, False))
+        pl.when(run_masked)(functools.partial(body, ik, True))
 
     for t in range(hp):
         m, l, acc = m_scrs[t][...], l_scrs[t][...], acc_scrs[t][...]
@@ -423,9 +492,9 @@ def _heads_per_program(d: int, interpret: bool) -> int:
     )
 
 
-def _flash_forward(q3, k3, v3, seed_f, rope, *, num_heads, head_dim,
+def _flash_forward(q3, k3, v3, seed_f, seg_f, rope, *, num_heads, head_dim,
                    num_kv_heads, causal, block_q, block_k, interpret,
-                   dropout_rate):
+                   dropout_rate, segmented=False):
     # q3: FOLDED [b, s, h*d]. k3/v3: [b, s, kvh*d] with kvh == h when
     # hp > 1 (the caller expands grouped K/V to per-query-head copies —
     # the repeated-KV-MHA identity — because a paired program's two query
@@ -436,7 +505,9 @@ def _flash_forward(q3, k3, v3, seed_f, rope, *, num_heads, head_dim,
     # float32 bit-carrier (floats so custom_vjp has a well-defined
     # cotangent; re-bitcast to uint32 here, outside the kernel — Mosaic
     # can't bitcast scalars in-kernel). rope: None or (cos, sin) [s, d]
-    # f32.
+    # f32. seg_f: [b, s] float32 bit-carrier of the int32 segment ids
+    # (same custom_vjp trick as seed_f) when ``segmented``; ignored
+    # otherwise.
     seed_f = jax.lax.bitcast_convert_type(seed_f, jnp.uint32)
     b, s, _ = q3.shape
     h, d = num_heads, head_dim
@@ -455,17 +526,29 @@ def _flash_forward(q3, k3, v3, seed_f, rope, *, num_heads, head_dim,
     row_spec = pl.BlockSpec((1, hp, 1, s), lambda ib, ip, iq: (ib, ip, 0, 0))
     fuse_rope = rope is not None
     rope_args = tuple(rope) if fuse_rope else ()
+    seg_args = ()
+    seg_specs = []
+    if segmented:
+        # The same [b, s] id array enters twice — once blocked by q rows,
+        # once as the full k row — so the kernel's q/k segment views ride
+        # the grid like every other operand.
+        seg = jax.lax.bitcast_convert_type(seg_f, jnp.int32)
+        seg_args = (seg, seg)
+        seg_specs = [
+            pl.BlockSpec((1, block_q), lambda ib, ip, iq: (ib, iq)),
+            pl.BlockSpec((1, s), lambda ib, ip, iq: (ib, 0)),
+        ]
     from jax.experimental.pallas import tpu as pltpu
 
     outs = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_k=block_k, scale=scale, causal=causal,
             dropout_rate=dropout_rate, fuse_rope=fuse_rope,
-            hw_prng=not interpret, hp=hp,
+            hw_prng=not interpret, hp=hp, segmented=segmented,
         ),
         grid=grid,
         in_specs=[_seed_spec(), q_spec, kv_spec, kv_spec]
-        + (_rope_specs(s, d) if fuse_rope else []),
+        + (_rope_specs(s, d) if fuse_rope else []) + seg_specs,
         out_specs=[q_spec, row_spec]
         + ([q_spec, kv_spec] if fuse_rope else []),
         out_shape=[
@@ -479,7 +562,7 @@ def _flash_forward(q3, k3, v3, seed_f, rope, *, num_heads, head_dim,
             + [pltpu.VMEM((block_q, d), jnp.float32)] * hp
         ),
         interpret=interpret,
-    )(seed_f, q3, k3, v3, *rope_args)
+    )(seed_f, q3, k3, v3, *rope_args, *seg_args)
     if fuse_rope:
         return outs  # (o3, lse, rotated-scaled q3, rotated k3)
     o3, lse = outs
@@ -679,6 +762,7 @@ _FUSED_BWD_MAX_SEQ = 2048
 def _bwd_dkv_kernel(
     seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     scale, causal, dropout_rate, fuse_rope, hw_prng, hp, seq,
+    segmented=False,
 ):
     """dk/dv half of the two-kernel (split) backward.
 
@@ -699,9 +783,10 @@ def _bwd_dkv_kernel(
     regenerate bit-for-bit across the forward and both split kernels.
     """
     if fuse_rope:
-        cos_ref, sin_ref, dk_ref, dv_ref, *scrs = rest
-    else:
-        dk_ref, dv_ref, *scrs = rest
+        cos_ref, sin_ref, *rest = rest
+    if segmented:
+        qseg_ref, kseg_ref, *rest = rest
+    dk_ref, dv_ref, *scrs = rest
     dk_scrs, dv_scrs = scrs[:hp], scrs[hp:]
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -711,6 +796,10 @@ def _bwd_dkv_kernel(
     k_start = ik * block_k
     q_start = iq * block_q
     seed = _seed_from_ref(seed_ref)
+    mask_val = _SEG_MASK if segmented else _NEG_INF
+    if segmented:
+        qseg = qseg_ref[0, :][:, None]        # [bq, 1]
+        kseg = kseg_ref[0, :][None, :]        # [1, bk]
     salt0 = _block_salt()  # hoisted out of the pl.when bodies (see _fwd_kernel)
 
     def head_salt(t):
@@ -738,9 +827,15 @@ def _bwd_dkv_kernel(
                 preferred_element_type=jnp.float32,
             )  # [bq, bk] (scaled via q)
             if masked:
-                diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                        - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-                s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
+                valid = None
+                if causal:
+                    diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                            - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+                    valid = diff >= k_start - q_start
+                if segmented:
+                    same = qseg == kseg
+                    valid = same if valid is None else valid & same
+                s = jnp.where(valid, s, mask_val)
             p = jnp.exp(s - lse)
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -763,13 +858,23 @@ def _bwd_dkv_kernel(
                 preferred_element_type=jnp.float32,
             )
 
-    if not causal:
+    if not causal and not segmented:
         body(False)
     else:
-        needed = q_start + block_q - 1 >= k_start
-        full = q_start >= k_start + block_k - 1
-        pl.when(full)(functools.partial(body, False))
-        pl.when(needed & jnp.logical_not(full))(functools.partial(body, True))
+        if causal:
+            needed = q_start + block_q - 1 >= k_start
+            full = q_start >= k_start + block_k - 1
+        else:
+            needed = full = True
+        if segmented:
+            overlap, uniform = _seg_predicates(qseg, kseg)
+            run_full = full & uniform
+            run_masked = needed & overlap & jnp.logical_not(run_full)
+        else:
+            run_full = full
+            run_masked = needed & jnp.logical_not(full)
+        pl.when(run_full)(functools.partial(body, False))
+        pl.when(run_masked)(functools.partial(body, True))
 
     @pl.when(iq == pl.num_programs(3) - 1)
     def _flush():
@@ -787,6 +892,7 @@ def _bwd_dkv_kernel(
 def _bwd_dq_kernel(
     seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     scale, causal, dropout_rate, fuse_rope, hw_prng, hp, seq,
+    segmented=False,
 ):
     """dq half of the two-kernel (split) backward.
 
@@ -800,9 +906,10 @@ def _bwd_dq_kernel(
     chain as the dkv kernel so both halves see identical score gradients.
     """
     if fuse_rope:
-        cos_ref, sin_ref, dq_ref, *dq_scrs = rest
-    else:
-        dq_ref, *dq_scrs = rest
+        cos_ref, sin_ref, *rest = rest
+    if segmented:
+        qseg_ref, kseg_ref, *rest = rest
+    dq_ref, *dq_scrs = rest
     block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
     d = q_ref.shape[2] // hp
@@ -811,6 +918,10 @@ def _bwd_dq_kernel(
     q_start = iq * block_q
     k_start = ik * block_k
     seed = _seed_from_ref(seed_ref)
+    mask_val = _SEG_MASK if segmented else _NEG_INF
+    if segmented:
+        qseg = qseg_ref[0, :][:, None]        # [bq, 1]
+        kseg = kseg_ref[0, :][None, :]        # [1, bk]
     salt0 = _block_salt()  # hoisted out of the pl.when bodies (see _fwd_kernel)
 
     def head_salt(t):
@@ -836,9 +947,15 @@ def _bwd_dq_kernel(
                 preferred_element_type=jnp.float32,
             )
             if masked:
-                diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                        - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-                s = jnp.where(diff >= k_start - q_start, s, _NEG_INF)
+                valid = None
+                if causal:
+                    diff = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                            - jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+                    valid = diff >= k_start - q_start
+                if segmented:
+                    same = qseg == kseg
+                    valid = same if valid is None else valid & same
+                s = jnp.where(valid, s, mask_val)
             p = jnp.exp(s - lse)
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -853,13 +970,23 @@ def _bwd_dq_kernel(
                 ds.astype(k.dtype), k, preferred_element_type=jnp.float32
             ) * scale
 
-    if not causal:
+    if not causal and not segmented:
         body(False)
     else:
-        needed = q_start + block_q - 1 >= k_start
-        full = q_start >= k_start + block_k - 1
-        pl.when(full)(functools.partial(body, False))
-        pl.when(needed & jnp.logical_not(full))(functools.partial(body, True))
+        if causal:
+            needed = q_start + block_q - 1 >= k_start
+            full = q_start >= k_start + block_k - 1
+        else:
+            needed = full = True
+        if segmented:
+            overlap, uniform = _seg_predicates(qseg, kseg)
+            run_full = full & uniform
+            run_masked = needed & overlap & jnp.logical_not(run_full)
+        else:
+            run_full = full
+            run_masked = needed & jnp.logical_not(full)
+        pl.when(run_full)(functools.partial(body, False))
+        pl.when(run_masked)(functools.partial(body, True))
 
     @pl.when(ik == pl.num_programs(3) - 1)
     def _flush():
@@ -870,10 +997,10 @@ def _bwd_dq_kernel(
             dq_ref[0, :, pl.ds(t * d, d)] = dq.astype(dq_ref.dtype)
 
 
-def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
-                    head_dim, num_kv_heads, causal, block_q, block_k,
-                    interpret, dropout_rate, dlse=None,
-                    f32_kv_grads=False, backward=None):
+def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, seg_f, rope, *,
+                    num_heads, head_dim, num_kv_heads, causal, block_q,
+                    block_k, interpret, dropout_rate, dlse=None,
+                    f32_kv_grads=False, backward=None, segmented=False):
     # Folded operands throughout (see _flash_forward). The backward runs
     # its own block sizes: measured on v5e the backward is MXU/FLOP-bound
     # (5 dots per block, no online-softmax rescan), so causal block
@@ -930,7 +1057,18 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
     # 16 MB default scope, so the split two-kernel path (s-independent
     # VMEM) takes over. ``backward`` in {"fused", "split"} overrides for
     # the sweep (benchmarks/longseq_block_sweep.py) and the parity tests.
-    impl = backward or ("fused" if s <= _FUSED_BWD_MAX_SEQ else "split")
+    # Segmented instances always take the split path — segments were only
+    # taught to the split pair (the fused kernel's one-pass dq residency
+    # buys nothing once segment skipping fragments the block walk).
+    if segmented:
+        if backward == "fused":
+            raise NotImplementedError(
+                "segment_ids require the split backward (the fused kernel "
+                "has no segment masking)"
+            )
+        impl = "split"
+    else:
+        impl = backward or ("fused" if s <= _FUSED_BWD_MAX_SEQ else "split")
     if impl == "fused":
         # The fused pass takes its preferred 512 blocks (FLOP-bound, 5
         # dots per block pair; causal block-skipping computes 3/4 of the
@@ -946,8 +1084,13 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
     if impl == "split":
         kernel_kw = dict(scale=scale, causal=causal,
                          dropout_rate=dropout_rate, fuse_rope=fuse_rope,
-                         hw_prng=not interpret, hp=hp, seq=s)
+                         hw_prng=not interpret, hp=hp, seq=s,
+                         segmented=segmented)
         gqa_map = not (hp > 1 or group == 1)
+        seg_args = ()
+        if segmented:
+            seg = jax.lax.bitcast_convert_type(seg_f, jnp.int32)
+            seg_args = (seg, seg)
         # dkv pass: grid (b, h/hp, k blocks, q blocks) — dk/dv block
         # indices are constant in the innermost (q) dimension, so they
         # stay VMEM-resident accumulating across the q walk.
@@ -964,11 +1107,15 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
                              lambda ib, ip, ik, iq: (ib, ip, 0, iq))
         rope_k = [pl.BlockSpec((block_k, d),
                                lambda ib, ip, ik, iq: (ik, 0))] * 2
+        seg_dkv = [
+            pl.BlockSpec((1, block_q), lambda ib, ip, ik, iq: (ib, iq)),
+            pl.BlockSpec((1, block_k), lambda ib, ip, ik, iq: (ib, ik)),
+        ] if segmented else []
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, **kernel_kw),
             grid=(b, h // hp, s // block_k, s // block_q),
             in_specs=[_seed_spec(), q_blk, kv_in, kv_in, q_blk, row_q,
-                      row_q] + (rope_k if fuse_rope else []),
+                      row_q] + (rope_k if fuse_rope else []) + seg_dkv,
             out_specs=[kv_out, kv_out],
             out_shape=[
                 jax.ShapeDtypeStruct((b, s, h * d), kv_grad_dtype),
@@ -978,7 +1125,7 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
                 [pltpu.VMEM((block_k, d), jnp.float32)] * (2 * hp)
             ),
             interpret=interpret,
-        )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args)
+        )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args, *seg_args)
         # dq pass: grid (b, h/hp, q blocks, k blocks) — the q/do/dq blocks
         # are constant in the innermost (k) dimension.
         q_blk2 = pl.BlockSpec((1, block_q, hp * d),
@@ -992,16 +1139,20 @@ def _flash_backward(q3, k3, v3, o3, lse, do3, seed_f, rope, *, num_heads,
                               lambda ib, ip, iq, ik: (ib, ip, 0, iq))
         rope_q = [pl.BlockSpec((block_q, d),
                                lambda ib, ip, iq, ik: (iq, 0))] * 2
+        seg_dq = [
+            pl.BlockSpec((1, block_q), lambda ib, ip, iq, ik: (ib, iq)),
+            pl.BlockSpec((1, block_k), lambda ib, ip, iq, ik: (ib, ik)),
+        ] if segmented else []
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, **kernel_kw),
             grid=(b, h // hp, s // block_q, s // block_k),
             in_specs=[_seed_spec(), q_blk2, kv_in2, kv_in2, q_blk2, row_q2,
-                      row_q2] + (rope_q if fuse_rope else []),
+                      row_q2] + (rope_q if fuse_rope else []) + seg_dq,
             out_specs=q_blk2,
             out_shape=jax.ShapeDtypeStruct((b, s, h * d), jnp.float32),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)] * hp,
             interpret=interpret,
-        )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args)
+        )(seed_f, q3, k3, v3, do3, lse, delta, *rope_args, *seg_args)
         if group > 1:
             dk = dk.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
                 b, s, kvh * d).astype(k3.dtype)
@@ -1051,7 +1202,8 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
                 dropout_rate: float, num_heads: int, head_dim: int,
                 fuse_rope: bool, return_lse: bool = False,
                 num_kv_heads: Optional[int] = None,
-                backward: Optional[str] = None):
+                backward: Optional[str] = None,
+                segmented: bool = False):
     """custom_vjp'd kernel entry over *folded* ``[b, s, h*d]`` operands.
 
     The fold matters twice. Memory: with head_dim 64, BSHD/BHSD tensors
@@ -1081,7 +1233,8 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
     kernel_kvh = h if expand_kv else kvh
     kw = dict(causal=causal, block_q=block_q, block_k=block_k,
               interpret=interpret, dropout_rate=dropout_rate,
-              num_heads=h, head_dim=d, num_kv_heads=kernel_kvh)
+              num_heads=h, head_dim=d, num_kv_heads=kernel_kvh,
+              segmented=segmented)
     bwd_kw = dict(kw, f32_kv_grads=expand_kv, backward=backward)
     # Backward block shapes are chosen per-path inside _flash_backward
     # (the fused pass prefers 512 blocks, the split kernels keep the
@@ -1105,33 +1258,35 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         return g3.reshape(b, s, kvh, group, d).sum(axis=3).reshape(
             b, s, kvh * d).astype(like.dtype)
 
-    def _fwd(q3, k3, v3, seed_f, cos, sin):
+    def _fwd(q3, k3, v3, seed_f, seg_f, cos, sin):
         # Returns (o3, lse, qr3, kr3): under fuse_rope the kernel emits the
         # rotated-scaled q and rotated k, which replace the raw q3/k3 in
         # the autodiff residuals so the backward never re-rotates per
-        # block; without rope qr3/kr3 are None.
+        # block; without rope qr3/kr3 are None. seg_f is the [b, s] f32
+        # bit-carrier of the int32 segment ids (a (1, 1) placeholder when
+        # not segmented — the same dance as seed_f).
         rope = (cos, sin) if fuse_rope else None
-        return _flash_forward(q3, _expand(k3), _expand(v3), seed_f, rope,
-                              **kw)
+        return _flash_forward(q3, _expand(k3), _expand(v3), seed_f, seg_f,
+                              rope, **kw)
 
-    def _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, cos, sin):
+    def _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, seg_f, cos, sin):
         if fuse_rope:
-            return (qr3, kr3, v3, o3, lse, seed_f, cos, sin)
-        return (q3, k3, v3, o3, lse, seed_f, cos, sin)
+            return (qr3, kr3, v3, o3, lse, seed_f, seg_f, cos, sin)
+        return (q3, k3, v3, o3, lse, seed_f, seg_f, cos, sin)
 
     def _bwd_impl(res, do3, dlse=None):
-        qs3, ks3, v3, o3, lse, seed_f, cos, sin = res
+        qs3, ks3, v3, o3, lse, seed_f, seg_f, cos, sin = res
         rope = (cos, sin) if fuse_rope else None
         # Under fuse_rope, ks3 is the kernel-width rotated k the forward
         # wrote (already expanded for GQA); otherwise expand the raw k3.
         kx3 = ks3 if fuse_rope else _expand(ks3)
         dq, dk, dv = _flash_backward(
-            qs3, kx3, _expand(v3), o3, lse, do3, seed_f, rope,
+            qs3, kx3, _expand(v3), o3, lse, do3, seed_f, seg_f, rope,
             dlse=dlse, **bwd_kw
         )
         return (dq, _group_sum(dk, v3), _group_sum(dv, v3),
-                jnp.zeros_like(seed_f), jnp.zeros_like(cos),
-                jnp.zeros_like(sin))
+                jnp.zeros_like(seed_f), jnp.zeros_like(seg_f),
+                jnp.zeros_like(cos), jnp.zeros_like(sin))
 
     if return_lse:
         # (o, lse [b, h, s]) variant for blockwise composition (ring
@@ -1139,14 +1294,15 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         # lse is a *differentiated* output — its cotangent folds into the
         # backward's delta row, see _flash_backward).
         @jax.custom_vjp
-        def flash(q3, k3, v3, seed_f, cos, sin):
-            o3, lse = _fwd(q3, k3, v3, seed_f, cos, sin)[:2]
+        def flash(q3, k3, v3, seed_f, seg_f, cos, sin):
+            o3, lse = _fwd(q3, k3, v3, seed_f, seg_f, cos, sin)[:2]
             return o3, lse[:, :, 0, :]
 
-        def fwd(q3, k3, v3, seed_f, cos, sin):
-            o3, lse, qr3, kr3 = _fwd(q3, k3, v3, seed_f, cos, sin)
+        def fwd(q3, k3, v3, seed_f, seg_f, cos, sin):
+            o3, lse, qr3, kr3 = _fwd(q3, k3, v3, seed_f, seg_f, cos, sin)
             return ((o3, lse[:, :, 0, :]),
-                    _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, cos, sin))
+                    _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, seg_f,
+                          cos, sin))
 
         def bwd(res, cot):
             do3, dlse = cot
@@ -1156,12 +1312,13 @@ def _make_flash(causal: bool, block_q: int, block_k: int, interpret: bool,
         return flash
 
     @jax.custom_vjp
-    def flash(q3, k3, v3, seed_f, cos, sin):
-        return _fwd(q3, k3, v3, seed_f, cos, sin)[0]
+    def flash(q3, k3, v3, seed_f, seg_f, cos, sin):
+        return _fwd(q3, k3, v3, seed_f, seg_f, cos, sin)[0]
 
-    def fwd(q3, k3, v3, seed_f, cos, sin):
-        o3, lse, qr3, kr3 = _fwd(q3, k3, v3, seed_f, cos, sin)
-        return o3, _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, cos, sin)
+    def fwd(q3, k3, v3, seed_f, seg_f, cos, sin):
+        o3, lse, qr3, kr3 = _fwd(q3, k3, v3, seed_f, seg_f, cos, sin)
+        return o3, _save(q3, k3, v3, o3, lse, qr3, kr3, seed_f, seg_f,
+                         cos, sin)
 
     def bwd(res, do3):
         return _bwd_impl(res, do3)
@@ -1184,8 +1341,19 @@ def flash_attention(
     rope: Optional[tuple] = None,
     return_lse: bool = False,
     backward: Optional[str] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blockwise causal flash attention; BSHD in, BSHD out.
+
+    ``segment_ids`` ([batch, seq] int) isolates attention within packed
+    documents: position i attends position j only when
+    ``segment_ids[b, i] == segment_ids[b, j]`` (on top of causality).
+    Blocks whose q-rows and k-columns share no segment are skipped at
+    block granularity — the generalization of the causal below-diagonal
+    skip — and only boundary blocks pay an elementwise mask. The packing
+    convention is 0 = padding (pad attends pad; mask those targets in the
+    loss) and documents 1..K. Segmented backward always runs the split
+    two-kernel path.
 
     ``dropout_rate > 0`` (with a PRNG key) applies attention-weight dropout
     *inside* the kernel via a counter-based mask — no [seq, seq] mask array
@@ -1215,6 +1383,18 @@ def flash_attention(
         raise ValueError(
             f"backward must be 'fused', 'split' or 'auto'; got {backward!r}"
         )
+    segmented = segment_ids is not None
+    if segmented:
+        if segment_ids.shape != (b, s):
+            raise ValueError(
+                f"segment_ids must be [batch, seq] = {(b, s)}; "
+                f"got {segment_ids.shape}"
+            )
+        if backward == "fused":
+            raise NotImplementedError(
+                "segment_ids require the split backward (the fused kernel "
+                "has no segment masking)"
+            )
     if h % k.shape[2] != 0:
         raise ValueError(
             f"num_heads {h} not divisible by num_kv_heads {k.shape[2]}"
@@ -1277,6 +1457,21 @@ def flash_attention(
             from tpu_trainer.ops.rope import apply_rotary_pos_emb
 
             q, k = apply_rotary_pos_emb(q, k, rope[0], rope[1])
+        if segmented:
+            # Dense segment-aware fallback (reference_attention builds the
+            # combined causal x segment mask); it is unconditionally
+            # causal, like the dropout fallback below.
+            if not causal:
+                raise NotImplementedError(
+                    "non-causal segmented attention has no fallback path"
+                )
+            from tpu_trainer.ops.attention import reference_attention
+
+            return reference_attention(
+                q, k, v, dropout_rate=dropout_rate,
+                deterministic=dropout_rate <= 0.0, dropout_rng=dropout_rng,
+                segment_ids=segment_ids,
+            )
         if dropout_rate > 0.0:
             # The XLA fused path has no attention dropout; keep the
             # configured semantics via the jnp reference path. That path is
@@ -1304,6 +1499,11 @@ def flash_attention(
     else:
         seed_bits = jnp.uint32(0)
     seed_f = jax.lax.bitcast_convert_type(seed_bits, jnp.float32).reshape(1, 1)
+    if segmented:
+        seg_f = jax.lax.bitcast_convert_type(
+            segment_ids.astype(jnp.int32), jnp.float32)
+    else:
+        seg_f = jnp.zeros((1, 1), jnp.float32)  # unused placeholder
     fuse_rope = rope is not None
     if fuse_rope:
         cos, sin = rope[0].astype(jnp.float32), rope[1].astype(jnp.float32)
@@ -1331,12 +1531,12 @@ def flash_attention(
         kvh = h_k
     fn = _make_flash(
         causal, block_q, block_k, interpret, float(dropout_rate), h_k, d,
-        fuse_rope, return_lse, kvh, backward,
+        fuse_rope, return_lse, kvh, backward, segmented,
     )
     # Folded [b, s, h*d] at the custom_vjp boundary (unpadded residuals).
     out = fn(
         q.reshape(b, s, h_k * d), k.reshape(b, s, kvh * d),
-        v.reshape(b, s, kvh * d), seed_f, cos, sin,
+        v.reshape(b, s, kvh * d), seed_f, seg_f, cos, sin,
     )
     if return_lse:
         o3, lse = out

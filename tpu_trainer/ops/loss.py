@@ -495,6 +495,24 @@ def _tp_loss(emb, x, shifted, mask, mesh, chunk_size):
     )(e_c, x_in, shifted, mask)
 
 
+def segment_target_mask(segment_ids: jax.Array) -> jax.Array:
+    """Float [batch, seq] mask of valid next-token targets under packing.
+
+    Position t predicts token t+1; that target is trained only when both
+    positions sit in the same non-padding document:
+    ``seg[t+1] == seg[t] and seg[t] != 0``. Masks the cross-document
+    leak (the last token of doc i must not be trained to predict the
+    first token of doc i+1) and all padding targets. The final position
+    comes out masked too (its shifted neighbor is the zero pad), matching
+    the ``pos < s - 1`` mask it composes with.
+    """
+    b = segment_ids.shape[0]
+    nxt = jnp.concatenate(
+        [segment_ids[:, 1:], jnp.zeros((b, 1), segment_ids.dtype)], axis=1
+    )
+    return ((segment_ids == nxt) & (segment_ids != 0)).astype(jnp.float32)
+
+
 def fused_shifted_cross_entropy(
     emb: jax.Array,
     x: jax.Array,
@@ -502,6 +520,7 @@ def fused_shifted_cross_entropy(
     *,
     chunk_size: int = 0,
     allow_pallas: bool = True,
+    segment_ids: jax.Array = None,
 ) -> jax.Array:
     """Mean next-token cross entropy of the tied LM head, logits-free.
 
@@ -518,8 +537,12 @@ def fused_shifted_cross_entropy(
       chunk_size: sequence-chunk length; 0 = auto (~8k tokens per chunk).
       allow_pallas: permit the Pallas kernel when eligible
         (``GPTConfig.fused_loss_pallas``).
+      segment_ids: optional ``[batch, seq]`` packed-document ids
+        (0 = padding); masks targets that cross a document boundary and
+        shrinks the mean's denominator to the surviving targets.
 
-    Returns: scalar float32 loss, averaged over ``batch * (seq - 1)``.
+    Returns: scalar float32 loss, averaged over the unmasked targets
+    (``batch * (seq - 1)`` without segments).
     """
     b, s, _ = x.shape
     shifted = jnp.concatenate(
@@ -527,6 +550,8 @@ def fused_shifted_cross_entropy(
     )
     pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
     mask = (pos < s - 1).astype(jnp.float32)
+    if segment_ids is not None:
+        mask = mask * segment_target_mask(segment_ids)
     from tpu_trainer.parallel.context import current_mesh
 
     mesh = current_mesh()

@@ -151,9 +151,29 @@ def summarize(records: List[dict]) -> dict:
             "fractions": {
                 k[:-len("_frac")]: v for k, v in sorted(g.items())
                 if k.endswith("_frac")
+                # (the ledger's token ratio is non_pad_token_ratio,
+                # deliberately outside this namespace; "packing" below)
                 and k not in ("productive_frac", "untracked_frac")
             },
             "untracked_frac": g.get("untracked_frac"),
+        }
+
+    # Sequence-packing efficiency: the loader-side cumulative non-pad token
+    # fraction rides the train records (MetricLogger.non_pad_frac) and the
+    # goodput ledger; cumulative → the last record is the run's number.
+    pack_fracs = [r.get("non_pad_frac") for r in train
+                  if r.get("non_pad_frac") is not None]
+    ledger_frac = None
+    if goodput:
+        final = [g2 for g2 in goodput if g2.get("final")] or goodput
+        ledger_frac = final[-1].get("non_pad_token_ratio")
+    if pack_fracs or ledger_frac is not None:
+        report["packing"] = {
+            "non_pad_frac": (pack_fracs[-1] if pack_fracs else ledger_frac),
+            "ledger_non_pad_frac": ledger_frac,
+            "effective_tok_per_sec": _stats(
+                [r.get("effective_tokens_per_sec") for r in steady
+                 if r.get("effective_tokens_per_sec") is not None]),
         }
 
     comms = by_kind.get("comms_model", [])
@@ -294,6 +314,13 @@ def render(report: dict) -> List[str]:
                      f" productive over {_fmt(g['total_seconds'], 1)}s"
                      f" | {fr}"
                      f" | untracked {_fmt((g.get('untracked_frac') or 0) * 100, 1)}%")
+    p = report.get("packing")
+    if p:
+        eff = p.get("effective_tok_per_sec")
+        eff_s = (f" | effective tok/s p50 {_fmt(eff['p50'], 0)}"
+                 if eff else "")
+        lines.append(
+            f"packing non-pad frac {_fmt(p['non_pad_frac'], 4)}{eff_s}")
     c = report.get("comms")
     if c:
         axes = "  ".join(f"{k} {_fmt(v / 1e6, 1)}MB"
@@ -354,7 +381,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             mfu_tol: float = 0.10, mem_tol: float = 0.10,
             loss_tol: float = 0.05, overhead_tol: float = 0.10,
             serve_lat_tol: float = 0.25,
-            recovery_tol: float = 120.0) -> List[dict]:
+            recovery_tol: float = 120.0,
+            pack_tol: float = 0.05) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -379,6 +407,14 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
       should cost exactly one restart; a second one means the first
       recovery itself died). SKIP when the baseline has no elastic
       records to anchor the count.
+
+    ``non_pad_frac`` is ABSOLUTE as well: the packed-data non-pad token
+    fraction dropping by >= ``pack_tol`` fraction points against the
+    baseline FAILs (bin-packing efficiency regressed — first-fit heuristic
+    change, bin-flush bug, loader reorder). Relative would mis-scale: a
+    0.98 -> 0.93 drop and a 0.40 -> 0.38 drop are both ~5% relative but
+    only the first burns five points of paid-for compute. SKIP when either
+    run doesn't track packing.
     """
     def get(report, *keys):
         cur = report
@@ -401,6 +437,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
         ("serve_tpot_p99_s", ("serve", "tpot_p99_s"), "lower", serve_lat_tol),
         ("decode_kv_tok_per_sec",
          ("decode", "kv_best_tok_per_sec"), "higher", tok_tol),
+        ("effective_tok_per_sec_p50",
+         ("packing", "effective_tok_per_sec", "p50"), "higher", tok_tol),
     ]
     verdicts = []
     eps = 1e-9
@@ -443,6 +481,23 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "new": round(n, 4),
             "delta_pct": round(delta * 100, 2),
             "tolerance_pct": round(overhead_tol * 100, 2),
+            "absolute": True,
+        })
+
+    b_frac = get(base, "packing", "non_pad_frac")
+    n_frac = get(new, "packing", "non_pad_frac")
+    if b_frac is None or n_frac is None:
+        verdicts.append({"metric": "non_pad_frac", "verdict": "SKIP",
+                         "base": b_frac, "new": n_frac})
+    else:
+        delta = b_frac - n_frac  # absolute, in fraction points
+        verdicts.append({
+            "metric": "non_pad_frac",
+            "verdict": "FAIL" if delta >= pack_tol - eps else "PASS",
+            "base": round(b_frac, 4),
+            "new": round(n_frac, 4),
+            "delta_pct": round(-delta * 100, 2),
+            "tolerance_pct": round(pack_tol * 100, 2),
             "absolute": True,
         })
 
@@ -518,6 +573,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "data_wait goodput share: FAIL if the new "
                              "run's share grows by >= this many fraction-"
                              "of-wall-clock points (default 0.10)")
+    parser.add_argument("--pack-tol", type=float, default=0.05,
+                        help="ABSOLUTE gate on the packed-data non-pad "
+                             "token fraction: FAIL if the new run's "
+                             "fraction drops by >= this many fraction "
+                             "points vs the baseline (default 0.05)")
     parser.add_argument("--recovery-tol", type=float, default=120.0,
                         help="ABSOLUTE gate on elastic recovery: FAIL if "
                              "any single host-death recovery in the new "
@@ -544,7 +604,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             mem_tol=args.mem_tol, loss_tol=args.loss_tol,
             overhead_tol=args.overhead_tol,
             serve_lat_tol=args.serve_lat_tol,
-            recovery_tol=args.recovery_tol)
+            recovery_tol=args.recovery_tol, pack_tol=args.pack_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

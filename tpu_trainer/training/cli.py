@@ -129,6 +129,24 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--data_path", type=str, default=None)
     p.add_argument("--max_tokens", type=int, default=None)
     p.add_argument("--streaming", action="store_true", default=None)
+    p.add_argument("--pack_sequences", action="store_true", default=None,
+                   help="first-fit sequence packing: ragged documents share "
+                        "rows, a segment-id channel keeps attention and "
+                        "loss per-document (data/packing.py); batches are "
+                        "[rows, seq, 2] (tokens, segment ids)")
+    p.add_argument("--max_open_bins", type=int, default=None,
+                   help="packing: max simultaneously open bins before the "
+                        "oldest is flushed (default 8)")
+    p.add_argument("--mask_doc_boundaries", action="store_true", default=None,
+                   help="concatenating text stream: derive segment ids from "
+                        "EOS positions so attention/loss never leak across "
+                        "documents (default off — bit-compat with runs "
+                        "checkpointed on the leaky stream)")
+    p.add_argument("--data_mixture", type=str, default=None,
+                   help="weighted multi-source mixture "
+                        "'name:weight[:path],...', e.g. 'tinystories:0.7:"
+                        "ts.txt,dummy:0.3'; names from {dummy, tinystories, "
+                        "openwebtext}; overrides --dataset (data/mixture.py)")
     p.add_argument("--cache_max_tokens", type=int, default=None)
     p.add_argument("--num_workers", type=int, default=None,
                    help="streaming tokenizer thread-pool size (0 = inline; "
@@ -476,6 +494,14 @@ def resolve_configs(args, mode: str):
         "data_path": _pick(args.data_path, y_data.get("path")),
         "max_tokens": _pick(args.max_tokens, y_data.get("max_tokens")),
         "streaming": bool(_pick(args.streaming, y_data.get("streaming"), False)),
+        "pack_sequences": bool(_pick(args.pack_sequences,
+                                     y_data.get("pack_sequences"), False)),
+        "max_open_bins": _picki(args.max_open_bins,
+                                y_data.get("max_open_bins"), 8),
+        "mask_doc_boundaries": bool(_pick(args.mask_doc_boundaries,
+                                          y_data.get("mask_doc_boundaries"),
+                                          False)),
+        "data_mixture": _pick(args.data_mixture, y_data.get("mixture")),
         "cache_max_tokens": _pick(args.cache_max_tokens,
                                   y_data.get("cache_max_tokens")),
         "num_workers": _pick(args.num_workers, y_data.get("num_workers"), 0),
@@ -521,8 +547,109 @@ def resolve_configs(args, mode: str):
     return model_config, training_config, parallel_config, data_opts
 
 
+def parse_mixture_spec(spec: str) -> dict:
+    """``'name:weight[:path],...'`` → ``{name: (weight, path)}``. Names must
+    be distinct dataset kinds from {dummy, tinystories, openwebtext} (the
+    mixture cursor keys per-source state by name)."""
+    out = {}
+    for part in spec.split(","):
+        fields = part.strip().split(":", 2)
+        if len(fields) < 2:
+            raise SystemExit(
+                f"bad --data_mixture entry {part.strip()!r}: expected "
+                f"name:weight[:path]"
+            )
+        name = fields[0].strip()
+        if name not in ("dummy", "tinystories", "openwebtext"):
+            raise SystemExit(f"unknown mixture source {name!r}")
+        if name in out:
+            raise SystemExit(f"duplicate mixture source {name!r}")
+        try:
+            weight = float(fields[1])
+        except ValueError:
+            raise SystemExit(
+                f"bad mixture weight {fields[1]!r} for source {name!r}"
+            ) from None
+        path = fields[2].strip() if len(fields) > 2 else None
+        out[name] = (weight, path)
+    return out
+
+
+def _packed_synthetic_loader(rows, seq_len, vocab_size, num_batches, seed,
+                             feed_rank, feed_world, max_open_bins, pack=True):
+    """Packed loader over a deterministic synthetic ragged corpus — the
+    dummy dataset's packed counterpart (and the bench's --packed input).
+    Documents stride across feed ranks so hosts pack disjoint rows."""
+    from tpu_trainer.data.packing import (PackedDataLoader,
+                                          synthetic_documents)
+
+    mean_len = max(8, seq_len // 4)
+    # Enough documents that every host can fill its num_batches * rows
+    # rows: ~seq/mean docs land per packed row, plus slack for pad waste
+    # in the pad-to-seq baseline lane (pack=False needs one row per doc).
+    per_row = max(1, seq_len // mean_len) if pack else 1
+    total_docs = num_batches * rows * feed_world * (per_row + 2)
+
+    def doc_fn():
+        docs = synthetic_documents(total_docs, mean_len, vocab_size,
+                                   seed=seed)
+        return (d for i, d in enumerate(docs)
+                if i % feed_world == feed_rank)
+
+    return PackedDataLoader(
+        doc_fn, rows, seq_len, max_open_bins=max_open_bins, pack=pack,
+        seed=seed, num_batches=num_batches,
+    )
+
+
+def _packed_text_loader(data_opts, rows, seq_len, feed_rank, feed_world,
+                        seed):
+    """Packed loader binning a text file's documents (lines) into full rows
+    via ``StreamingTextDataset.iter_documents`` — shard/holdout/budget rules
+    identical to the concatenating stream."""
+    from tpu_trainer.data.packing import PackedDataLoader
+    from tpu_trainer.data.text import StreamingTextDataset, TextDataLoader
+
+    holdout_every = (data_opts["eval_holdout_every"]
+                     if data_opts["streaming"] else 0)
+    common = dict(
+        tokenizer_name=data_opts["tokenizer"],
+        max_tokens=data_opts["max_tokens"],
+        cache_max_tokens=data_opts["cache_max_tokens"],
+        shard_id=feed_rank,
+        num_shards=feed_world,
+        tokenizer_on_fallback="error",
+    )
+    ds = StreamingTextDataset(
+        data_opts["data_path"], seq_len,
+        num_workers=data_opts["num_workers"],
+        holdout=("train", holdout_every) if holdout_every else None,
+        **common,
+    )
+    train = PackedDataLoader(
+        ds.iter_documents, rows, seq_len,
+        max_open_bins=data_opts["max_open_bins"], seed=seed,
+    )
+    eval_loader = None
+    if holdout_every:
+        # Held-out eval stays on the plain concatenating stream ([rows,
+        # seq]): eval_step handles both formats, and eval loss on unpacked
+        # rows is comparable across packed/unpacked training runs.
+        eval_ds = StreamingTextDataset(
+            data_opts["data_path"], seq_len,
+            holdout=("eval", holdout_every), **common,
+        )
+        eval_loader = TextDataLoader(
+            eval_ds, rows, process_index=feed_rank,
+            process_count=feed_world, seed=seed, prefetch=0,
+        )
+    return train, eval_loader
+
+
 def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
-    """Train + (optional) eval loaders yielding per-host ``[rows, seq]``.
+    """Train + (optional) eval loaders yielding per-host ``[rows, seq]``
+    (or ``[rows, seq, 2]`` with a segment-id channel when packing or
+    boundary masking is on).
 
     rows = grad_accum x micro_batch x (local data shards) — the reference's
     loader-batch semantics (``ddp_trainer.py:538``) applied per host.
@@ -534,8 +661,29 @@ def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
     feed_rank, feed_world = trainer.data_feed_rank, trainer.data_feed_world
     rows = (c.gradient_accumulation_steps * c.batch_size * trainer.dp_size
             ) // feed_world
+    if data_opts.get("data_mixture"):
+        return _build_mixture(data_opts, trainer, model_config, rows,
+                              feed_rank, feed_world)
     name = data_opts["dataset"]
+    pack = data_opts.get("pack_sequences")
+    if pack and name != "dummy":
+        if not data_opts["data_path"]:
+            raise SystemExit(f"--data_path is required for dataset {name!r}")
+        return _packed_text_loader(data_opts, rows, c.max_seq_len,
+                                   feed_rank, feed_world, c.seed)
     if name == "dummy":
+        if pack:
+            train = _packed_synthetic_loader(
+                rows, c.max_seq_len, model_config.vocab_size,
+                data_opts["num_batches"], c.seed + 1234, feed_rank,
+                feed_world, data_opts["max_open_bins"],
+            )
+            eval_loader = _packed_synthetic_loader(
+                rows, c.max_seq_len, model_config.vocab_size,
+                data_opts["eval_batches"], c.seed + 4321, feed_rank,
+                feed_world, data_opts["max_open_bins"],
+            )
+            return train, eval_loader
         from tpu_trainer.data.dummy import create_dummy_dataloader
 
         train = create_dummy_dataloader(
@@ -588,8 +736,86 @@ def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
         eval_split=0.0 if data_opts["streaming"] else data_opts["eval_split"],
         eval_holdout_every=(data_opts["eval_holdout_every"]
                             if data_opts["streaming"] else 0),
+        # Cross-document loss-leak fix (streaming only; map-style chunks
+        # have no in-chunk boundary metadata to derive segments from).
+        mask_doc_boundaries=(data_opts["mask_doc_boundaries"]
+                             if data_opts["streaming"] else False),
     )
     return train, train.eval_loader
+
+
+def _build_mixture(data_opts, trainer, model_config, rows, feed_rank,
+                   feed_world):
+    """Weighted multi-source mixture (``--data_mixture``). Every source
+    yields the same per-host batch shape — plain ``[rows, seq]``, or
+    ``[rows, seq, 2]`` when ``--pack_sequences`` puts all sources (dummy
+    included, via the synthetic ragged corpus) on the packed format."""
+    from tpu_trainer.data.mixture import MixtureDataLoader
+
+    c = trainer.training_config
+    spec = parse_mixture_spec(data_opts["data_mixture"])
+    pack = data_opts.get("pack_sequences")
+    mask = data_opts.get("mask_doc_boundaries")
+    if mask and not pack and "dummy" in spec:
+        raise SystemExit(
+            "--data_mixture with --mask_doc_boundaries cannot include the "
+            "'dummy' source (its batches carry no segment channel, so the "
+            "shapes would disagree); add --pack_sequences or drop dummy"
+        )
+    sources, weights = {}, {}
+    for idx, nm in enumerate(sorted(spec)):
+        weight, path = spec[nm]
+        weights[nm] = weight
+        sub_seed = c.seed + 1000 * (idx + 1)   # disjoint per-source streams
+        if nm == "dummy":
+            if pack:
+                sources[nm] = _packed_synthetic_loader(
+                    rows, c.max_seq_len, model_config.vocab_size,
+                    data_opts["num_batches"], sub_seed, feed_rank,
+                    feed_world, data_opts["max_open_bins"],
+                )
+            else:
+                from tpu_trainer.data.dummy import create_dummy_dataloader
+
+                sources[nm] = create_dummy_dataloader(
+                    batch_size=rows * feed_world, seq_len=c.max_seq_len,
+                    vocab_size=model_config.vocab_size,
+                    num_batches=data_opts["num_batches"], seed=sub_seed,
+                    process_index=feed_rank, process_count=feed_world,
+                )
+            continue
+        if not path:
+            raise SystemExit(
+                f"mixture source {nm!r} needs a path "
+                f"('{nm}:<weight>:<path>')"
+            )
+        if pack:
+            opts = dict(data_opts, data_path=path, streaming=True,
+                        eval_holdout_every=0)
+            train, _ = _packed_text_loader(opts, rows, c.max_seq_len,
+                                           feed_rank, feed_world, sub_seed)
+            sources[nm] = train
+        else:
+            from tpu_trainer.data.text import create_text_dataloader
+
+            sources[nm] = create_text_dataloader(
+                path, batch_size=rows, seq_len=c.max_seq_len,
+                tokenizer_name=data_opts["tokenizer"],
+                max_tokens=data_opts["max_tokens"], streaming=True,
+                cache_max_tokens=data_opts["cache_max_tokens"],
+                process_index=feed_rank, process_count=feed_world,
+                seed=sub_seed, num_workers=data_opts["num_workers"],
+                # Sub-loaders draw on demand; background prefetch threads
+                # would race the mixture's deterministic draw order for no
+                # overlap win (the mixture itself sits behind feed prefetch).
+                prefetch=0, tokenizer_on_fallback="error",
+                mask_doc_boundaries=bool(mask),
+            )
+    train = MixtureDataLoader(sources, weights, seed=c.seed)
+    # No held-out eval across a mixture (per-source holdouts would need
+    # per-source eval weighting to mean anything); eval stays available via
+    # single-source runs.
+    return train, None
 
 
 def run_training(argv=None, mode: str = "ddp") -> int:
@@ -1051,6 +1277,20 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                                 transform = _nan_loss_transform
                             if faults.fire("loss_spike", step):
                                 transform = _loss_spike_transform
+                            # Padding-waste accounting: loaders that pack
+                            # (or segment) expose the cumulative non-pad
+                            # fraction; the logger turns it into
+                            # effective_tokens_per_sec, the ledger into the
+                            # run-level non-pad goodput numbers. Loaders
+                            # without the stat count as fully dense.
+                            npf = getattr(train_loader, "non_pad_frac", None)
+                            if npf is not None:
+                                logger.non_pad_frac = float(npf)
+                            ledger.add_tokens(
+                                trainer.tokens_per_step,
+                                None if npf is None else int(round(
+                                    trainer.tokens_per_step * float(npf))),
+                            )
                             consume(deferred.push(step, metrics, transform))
                     if heartbeat is not None:
                         heartbeat.beat(step + 1)

@@ -96,6 +96,15 @@ from tpu_trainer.utils.quant import (  # noqa: E402,F401
 )
 
 
+def _split_packed(batch: jax.Array):
+    """``[rows, seq]`` → ``(tokens, None)``; packed ``[rows, seq, 2]`` →
+    ``(tokens, segment_ids)``. The channel-last convention: ``[..., 0]`` is
+    token ids, ``[..., 1]`` is segment ids (0 = padding, docs 1..K)."""
+    if batch.ndim >= 3 and batch.shape[-1] == 2:
+        return batch[..., 0], batch[..., 1]
+    return batch, None
+
+
 def _path_keys(path) -> tuple:
     """Pytree path -> hashable tuple of key strings."""
     return tuple(
@@ -707,9 +716,15 @@ class Trainer:
         """Host numpy ``[accum * local_bs, seq]`` → global sharded device array
         ``[accum, global_bs, seq]`` (↔ reference micro-batch slicing,
         ``ddp_trainer.py:320-326``, done once here instead of per micro-step).
+
+        Packed batches arrive as ``[accum * local_bs, seq, 2]`` — channel 0
+        tokens, channel 1 segment ids — and come out ``[accum, global_bs,
+        seq, 2]``. The batch PartitionSpec is 3-D, so the trailing channel
+        dim stays replicated without a second sharding.
         """
         accum = self.training_config.gradient_accumulation_steps
-        n, seq = local_batch.shape
+        packed = local_batch.ndim == 3
+        n, seq = local_batch.shape[:2]
         if n % accum != 0:
             raise ValueError(f"batch rows {n} not divisible by accum {accum}")
         # Out-of-vocab ids make the embedding gather silently produce garbage
@@ -717,18 +732,20 @@ class Trainer:
         # ~free next to the device step. Typical trigger: byte tokenizer ids
         # (<= 50256) against a shrunken vocab_size.
         vocab = self.model_config.vocab_size
-        top = int(local_batch.max()) if local_batch.size else 0
-        if top >= vocab or int(local_batch.min() if local_batch.size else 0) < 0:
+        tokens = local_batch[..., 0] if packed else local_batch
+        top = int(tokens.max()) if tokens.size else 0
+        if top >= vocab or int(tokens.min() if tokens.size else 0) < 0:
             raise ValueError(
                 f"batch contains token id {top} outside [0, {vocab}) — "
                 f"tokenizer/vocab_size mismatch (e.g. byte-tokenizer ids "
                 f"with a reduced model vocab)"
             )
-        local = local_batch.reshape(accum, n // accum, seq)
+        tail = local_batch.shape[2:]
+        local = local_batch.reshape(accum, n // accum, seq, *tail)
         # feed_world, not process_count: hosts sharing a data shard (a
         # sequence/tensor axis spanning hosts) each pass the same rows, and
         # the global row count scales with the number of DISTINCT slices.
-        global_shape = (accum, (n // accum) * self.data_feed_world, seq)
+        global_shape = (accum, (n // accum) * self.data_feed_world, seq, *tail)
         return jax.make_array_from_process_local_data(
             self.batch_sharding, local, global_shape
         )
@@ -738,13 +755,17 @@ class Trainer:
     def place_batch(self, batch) -> jax.Array:
         """Host array ``[accum * local_bs, seq]`` (or ``[accum, local_bs,
         seq]``) → the sharded ``[accum, global_bs, seq]`` device array the
-        jitted step expects; device arrays pass through. Public: the
-        device-prefetch feed (``data/device_prefetch.py``) uses this to
-        enqueue H2D copies ahead of the step."""
+        jitted step expects; device arrays pass through. Packed batches
+        carry a trailing ``2`` channel dim (tokens, segment ids) and are
+        recognized by ``shape[-1] == 2`` (a real seq dim is never 2).
+        Public: the device-prefetch feed (``data/device_prefetch.py``) uses
+        this to enqueue H2D copies ahead of the step."""
         if not isinstance(batch, jax.Array):
             batch = np.asarray(batch)
-            if batch.ndim == 3:
-                batch = batch.reshape(-1, batch.shape[-1])
+            packed = batch.shape[-1] == 2
+            flat_ndim = 3 if packed else 2
+            if batch.ndim == flat_ndim + 1:
+                batch = batch.reshape(-1, *batch.shape[2:])
             batch = self.put_batch(batch)
         return batch
 
@@ -876,10 +897,12 @@ class Trainer:
         batch = self.place_batch(batch)
 
         def scan_fn(st, micro):
+            tokens, segs = _split_packed(micro)
             with telemetry.capture(deep=True) as cap:
                 with self._sp_context():
                     _, loss = self.model.apply(
-                        {"params": st.params}, micro, labels=micro
+                        {"params": st.params}, tokens, labels=tokens,
+                        segment_ids=segs,
                     )
             stats = telemetry.assemble(cap.stats)
             stats["loss"] = loss
@@ -901,17 +924,19 @@ class Trainer:
         promised (``ddp_trainer.py:52``, SURVEY.md §0.1)."""
         if not isinstance(batch, jax.Array):
             local = np.asarray(batch)
-            n, seq = local.shape
+            n, seq = local.shape[:2]
             batch = jax.make_array_from_process_local_data(
                 self._eval_batch_sharding, local,
-                (n * self.data_feed_world, seq)
+                (n * self.data_feed_world, seq) + local.shape[2:]
             )
         return self._eval_jit(state, batch)
 
     def _eval_step(self, state: TrainState, batch: jax.Array):
+        tokens, segs = _split_packed(batch)
         with self._sp_context():
             _, loss = self.model.apply(
-                {"params": state.params}, batch, labels=batch
+                {"params": state.params}, tokens, labels=tokens,
+                segment_ids=segs,
             )
         return loss
 
@@ -932,9 +957,10 @@ class Trainer:
                     telemetry_on: bool = False):
         cfg = self.training_config
         accum = cfg.gradient_accumulation_steps
-        assert batch.ndim == 3 and batch.shape[0] == accum
+        assert batch.ndim in (3, 4) and batch.shape[0] == accum
 
         def loss_fn(params, micro, rng, scale):
+            tokens, segs = _split_packed(micro)
             # With the carried cast, the forward consumes the state's
             # compute-dtype copy; gradients still land on the f32 master
             # (_linked_cast routes the cotangents through the
@@ -951,10 +977,11 @@ class Trainer:
                 with self._sp_context():
                     _, loss = self.model.apply(
                         {"params": params},
-                        micro,
-                        labels=micro,
+                        tokens,
+                        labels=tokens,
                         train=True,
                         rngs={"dropout": rng},
+                        segment_ids=segs,
                     )
             if telemetry_on:
                 return loss * scale, (loss, telemetry.assemble(cap.stats))

@@ -931,6 +931,13 @@ def remap_data_state(
     map-style text loaders (their global order is independent of the feed
     world); best-effort at the same granularity for the streaming loader,
     whose line-modulo shards re-partition with the feed world.
+
+    A ``kind == "mixture"`` cursor (``data/mixture.py``) needs no special
+    casing here: its top-level ``batch_index`` remaps exactly like any
+    other, and ``MixtureDataLoader.load_state_dict`` re-derives the
+    per-source sub-cursors from the remapped index (the draw counts are a
+    pure function of ``(seed, weights, batch_index)``), so the ``sources``
+    sub-dicts passing through untouched is correct.
     """
     if data_state is None:
         return None, 0

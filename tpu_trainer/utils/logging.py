@@ -182,6 +182,11 @@ class MetricLogger:
                     f"tensorboard sink disabled: {type(e).__name__}: {e}"
                 )
         self.tokens_seen = 0
+        # Non-pad token fraction of the batches fed (sequence packing);
+        # set by the run loop from the dataloader's accounting. None =
+        # padding untracked → the effective-throughput fields stay absent
+        # and old JSONL records are byte-identical.
+        self.non_pad_frac: Optional[float] = None
         self._t0 = time.perf_counter()
         self._window_t = self._t0
         self._window_tokens = 0
@@ -217,6 +222,11 @@ class MetricLogger:
             "tokens_per_sec_per_chip": round(tok_per_sec / self._n_chips, 1),
             "elapsed_s": round(now - self._t0, 3),
         }
+        if self.non_pad_frac is not None:
+            record["non_pad_frac"] = round(float(self.non_pad_frac), 4)
+            record["effective_tokens_per_sec"] = round(
+                tok_per_sec * float(self.non_pad_frac), 1
+            )
         if self.model_config is not None and self._on_accelerator:
             record["mfu"] = round(
                 mfu(tok_per_sec, self.model_config, self._n_chips, self._peak,
@@ -236,6 +246,10 @@ class MetricLogger:
             parts = [f"step {record['step']:>6d}", f"loss {record['loss']:.4f}",
                      f"lr {record['lr']:.2e}",
                      f"{record['tokens_per_sec']:,.0f} tok/s"]
+            if "effective_tokens_per_sec" in record:
+                parts.append(
+                    f"{record['effective_tokens_per_sec']:,.0f} eff tok/s"
+                )
             if "mfu" in record:
                 parts.append(f"mfu {record['mfu']:.1%}")
             if "peak_mem_gb" in record:

@@ -323,6 +323,17 @@ class GoodputLedger:
         self._clock = clock
         self._t0 = clock()
         self._acc: Dict[str, float] = {}
+        # Padding-waste accounting (sequence packing): tokens fed vs tokens
+        # that were real data. effective tok/s = non-pad tokens over step
+        # time — the number packing moves.
+        self._tokens = 0
+        self._nonpad_tokens = 0
+
+    def add_tokens(self, total: int, non_pad: Optional[int] = None) -> None:
+        """Count one step's fed tokens; ``non_pad`` defaults to all of them
+        (unpacked batches have no padding)."""
+        self._tokens += int(total)
+        self._nonpad_tokens += int(total if non_pad is None else non_pad)
 
     @contextlib.contextmanager
     def track(self, category: str):
@@ -358,6 +369,15 @@ class GoodputLedger:
             if cat in self._acc:
                 rec[f"{cat}_seconds"] = self._acc[cat]
                 rec[f"{cat}_frac"] = self._acc[cat] / total
+        if self._tokens:
+            rec["tokens"] = self._tokens
+            rec["non_pad_tokens"] = self._nonpad_tokens
+            # A token ratio, NOT a wall-clock share — deliberately named
+            # outside the "*_frac" namespace every goodput consumer sums.
+            rec["non_pad_token_ratio"] = self._nonpad_tokens / self._tokens
+            step_s = self._acc.get("step", 0.0)
+            if step_s > 0:
+                rec["effective_tok_per_sec"] = self._nonpad_tokens / step_s
         return rec
 
     def summary_lines(self) -> List[str]:
@@ -377,6 +397,13 @@ class GoodputLedger:
             f"{rec['untracked_frac'] * rec['total_seconds']:9.2f}s "
             f"{rec['untracked_frac']:6.1%}"
         )
+        if "non_pad_token_ratio" in rec:
+            eff = rec.get("effective_tok_per_sec")
+            eff_s = f", {eff:,.0f} effective tok/s" if eff else ""
+            lines.append(
+                f"  non-pad tokens: {rec['non_pad_tokens']:,} / "
+                f"{rec['tokens']:,} ({rec['non_pad_token_ratio']:.1%}){eff_s}"
+            )
         return lines
 
 
