@@ -1,0 +1,107 @@
+#!/usr/bin/env bash
+# Chaos lane: the full elastic fault matrix against a real supervisor
+# (training/elastic.py), one scenario per run dir. Every scenario bounds
+# its restart budget with --max_restarts so a broken recovery fails the
+# lane instead of restarting forever; analyze.py gates each run's
+# supervisor.jsonl afterwards (recovery/grow seconds, restart count,
+# failure-to-regrow).
+#
+# Usage:
+#   ./scripts/chaos.sh [out_dir]           # default /tmp/tpu_trainer_chaos
+#
+# The pytest equivalents (tier-1, deterministic, asserting on the JSONL
+# records) are `pytest -m chaos`; this script is the manual/soak version
+# of the same matrix with room to crank worlds and steps up.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${1:-/tmp/tpu_trainer_chaos}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+mkdir -p "$OUT"
+
+CONFIG="$OUT/tiny.yaml"
+cat > "$CONFIG" <<'YAML'
+model:
+  name: "gpt2-small"
+  vocab_size: 128
+  hidden_size: 32
+  num_layers: 1
+  num_heads: 2
+  intermediate_size: 64
+  max_seq_len: 32
+  dropout: 0.0
+  attention_dropout: 0.0
+  use_flash_attention: false
+training:
+  batch_size: 2
+  learning_rate: 1e-3
+  max_steps: 32
+  warmup_steps: 2
+  log_interval: 1
+  eval_interval: 0
+  save_interval: 4
+  seed: 0
+data:
+  dataset: "dummy"
+YAML
+
+supervise() {  # supervise <name> <expected_rc> <supervisor flags...> -- <trainer flags...>
+  local name="$1" want_rc="$2"; shift 2
+  local run="$OUT/$name"
+  rm -rf "$run"
+  echo "== chaos: $name =="
+  set +e
+  python -m tpu_trainer.training.elastic \
+    --run_dir "$run" --startup_grace_s 240 --coordinator_timeout_s 120 \
+    "$@" --config "$CONFIG" --checkpoint_dir "$run/ckpt" \
+    --no_comms_model --guard_interval 0
+  local rc=$?
+  set -e
+  if [ "$rc" -ne "$want_rc" ]; then
+    echo "chaos: $name exited $rc (wanted $want_rc)" >&2
+    exit 1
+  fi
+  # Gate the run's own records (self-compare exercises the absolute gates:
+  # recovery/grow seconds vs fixed budgets, regrow-to-desired-world).
+  python -m tpu_trainer.tools.analyze "$run/supervisor.jsonl" \
+    --compare "$run/supervisor.jsonl"
+}
+
+# 1. Host crash: 2 -> 1 shrink, resume from the last committed checkpoint.
+supervise kill_host 0 \
+  --num_processes 2 --max_restarts 1 -- \
+  --inject_fault kill_host@5
+
+# 2. Two hosts die in the same poll interval: ONE restart, 3 -> 1.
+TPU_TRAINER_FAULT_HOST="1,2" supervise co_death 0 \
+  --num_processes 3 --max_restarts 1 -- \
+  --inject_fault kill_host@5
+
+# 3. Hung host (no exit, stale heartbeats): detection is the assertion,
+#    so no restart budget — the supervisor gives up after blaming it.
+supervise hang_host 1 \
+  --num_processes 2 --max_restarts 0 --heartbeat_timeout_s 5 -- \
+  --inject_fault hang_host@3 --max_steps 100000 --save_interval 100000
+
+# 4. Preemption notice: proactive drain (checkpoint + drain marker +
+#    clean exit) before the grace deadline; reform rolls back 0 steps.
+supervise preempt_notice 0 \
+  --num_processes 2 --max_restarts 1 -- \
+  --inject_fault preempt_notice@4 --preempt_vote_interval 1 \
+  --preemption_grace_s 60
+
+# 5. Notice drain with a warm standby promoted into the reform.
+supervise notice_standby 0 \
+  --num_processes 2 --max_restarts 1 --standby_hosts 1 -- \
+  --inject_fault preempt_notice@4 --preempt_vote_interval 1 \
+  --preemption_grace_s 60
+
+# 6. Shrink then grow back: kill at 5, capacity re-granted at 6, the
+#    --allow_grow probe drains the shrunk attempt and relaunches at the
+#    desired world. Grows don't consume the restart budget.
+supervise grow_back 0 \
+  --num_processes 2 --max_restarts 1 --allow_grow \
+  --grow_probe_interval_s 0.2 -- \
+  --inject_fault kill_host@5,return_host@6 --max_steps 64
+
+echo "chaos: full matrix clean ($OUT)"

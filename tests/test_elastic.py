@@ -451,7 +451,7 @@ def log_losses(log_path):
 
 def run_supervisor(run_dir, tiny_yaml, *, num_processes=2, max_restarts=2,
                    heartbeat_timeout_s=30.0, trainer_args=(), timeout=420,
-                   **sup_kw):
+                   env_extra=None, **sup_kw):
     cmd = [sys.executable, "-m", "tpu_trainer.training.elastic",
            "--num_processes", str(num_processes),
            "--run_dir", str(run_dir),
@@ -460,14 +460,31 @@ def run_supervisor(run_dir, tiny_yaml, *, num_processes=2, max_restarts=2,
            "--startup_grace_s", "240",
            "--coordinator_timeout_s", "120"]
     for k, v in sup_kw.items():
-        cmd += [f"--{k}", str(v)]
+        if v is True:  # store_true supervisor flags (--allow_grow)
+            cmd += [f"--{k}"]
+        else:
+            cmd += [f"--{k}", str(v)]
     cmd += ["--", "--config", tiny_yaml,
             "--checkpoint_dir", os.path.join(str(run_dir), "ckpt"),
             "--no_comms_model", "--guard_interval", "0", *trainer_args]
-    return subprocess.run(cmd, capture_output=True, text=True, env=_env(),
+    env = _env()
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
                           timeout=timeout)
 
 
+def all_log_losses(run_dir):
+    """step -> loss across every attempt's (and standby's) trainer log."""
+    import glob
+    losses = {}
+    for p in sorted(glob.glob(os.path.join(str(run_dir), "host*_attempt*.log"))
+                    + glob.glob(os.path.join(str(run_dir), "standby*.log"))):
+        losses.update(log_losses(p))
+    return losses
+
+
+@pytest.mark.chaos
 class TestElasticSupervisor:
     def test_kill_host_shrinks_mesh_and_resumes(self, tiny_yaml, tmp_path):
         # THE chaos-lane acceptance scenario: 2 processes, rank 1 hard-dies
@@ -565,6 +582,184 @@ class TestElasticSupervisor:
         summary = [e for e in events if e.get("kind") == "elastic_summary"]
         assert summary and summary[-1]["exit_code"] == 1
         assert summary[-1]["restarts"] == 0
+
+
+@pytest.mark.chaos
+class TestElasticReform:
+    """Satellite drills: deaths the reform loop must not mishandle."""
+
+    def test_first_attempt_death_before_any_checkpoint(self, tiny_yaml,
+                                                       tmp_path):
+        # kill_host@1 with saving disabled: the dead attempt leaves NO
+        # checkpoint behind. The reformed world-1 run must start from
+        # scratch — restore_latest over an empty tree is "no checkpoint",
+        # not a crash on a missing meta.json — and still finish.
+        run_dir = tmp_path / "run"
+        r = run_supervisor(run_dir, tiny_yaml,
+                           trainer_args=("--inject_fault", "kill_host@1",
+                                         "--save_interval", "100000"))
+        assert r.returncode == 0, r.stdout + r.stderr
+        events = read_jsonl(run_dir / "supervisor.jsonl")
+        recoveries = [e for e in events if e.get("kind") == "recovery"]
+        assert len(recoveries) == 1
+        assert (recoveries[0]["world_before"], recoveries[0]["world_after"]) \
+            == (2, 1)
+        log1 = (run_dir / "host0_attempt1.log").read_text()
+        assert "resumed from" not in log1
+        # From-scratch means the whole trajectory re-ran on world 1.
+        assert set(log_losses(run_dir / "host0_attempt1.log")) == set(range(9))
+
+    def test_two_hosts_die_same_interval_one_restart(self, tiny_yaml,
+                                                     tmp_path):
+        # Ranks 1 AND 2 of a 3-host pod die at the same step. The settle
+        # window must coalesce them into ONE teardown + ONE restart
+        # (3 -> 1), not burn two restarts out of the budget on one event.
+        run_dir = tmp_path / "run"
+        r = run_supervisor(run_dir, tiny_yaml, num_processes=3,
+                           trainer_args=("--inject_fault", "kill_host@5"),
+                           env_extra={"TPU_TRAINER_FAULT_HOST": "1,2"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        events = read_jsonl(run_dir / "supervisor.jsonl")
+        deaths = [e for e in events if e.get("kind") == "host_death"]
+        assert sorted(d["host"] for d in deaths) == [1, 2]
+        assert all(d["cause"] == f"exit:{faults.KILL_EXIT_CODE}"
+                   for d in deaths)
+        recoveries = [e for e in events if e.get("kind") == "recovery"]
+        assert len(recoveries) == 1
+        assert (recoveries[0]["world_before"], recoveries[0]["world_after"]) \
+            == (3, 1)
+        summary = [e for e in events if e.get("kind") == "elastic_summary"]
+        assert summary[-1]["restarts"] == 1 and summary[-1]["exit_code"] == 0
+        assert set(all_log_losses(run_dir)) == set(range(9))
+
+
+@pytest.mark.chaos
+class TestElasticGrowBack:
+    def test_shrink_then_grow_back(self, tiny_yaml, tmp_path):
+        # THE grow-back acceptance scenario (2 -> 1 -> 2): rank 1 dies at
+        # step 5, the run survives shrunk to world 1; at step 6 the
+        # return_host fault plays the cluster re-granting a host
+        # (capacity.json); the --allow_grow probe catches the grant, drains
+        # the world-1 attempt through its SIGTERM checkpoint path, and
+        # relaunches at world 2 — which finishes the run. The loss ledger
+        # must be gap-free across all three attempts.
+        run_dir = tmp_path / "run"
+        r = run_supervisor(
+            run_dir, tiny_yaml,
+            trainer_args=("--inject_fault", "kill_host@5,return_host@6",
+                          "--max_steps", "64", "--save_interval", "4"),
+            allow_grow=True, grow_probe_interval_s=0.1)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        events = read_jsonl(run_dir / "supervisor.jsonl")
+        recoveries = [e for e in events if e.get("kind") == "recovery"]
+        assert len(recoveries) == 1
+        assert (recoveries[0]["world_before"], recoveries[0]["world_after"]) \
+            == (2, 1)
+        grows = [e for e in events if e.get("kind") == "world_grow"]
+        assert len(grows) == 1, r.stdout
+        assert (grows[0]["world_before"], grows[0]["world_after"]) == (1, 2)
+        assert grows[0]["grow_seconds"] >= 0
+        # The drain checkpointed at the step boundary: the grown attempt
+        # resumed exactly where the shrunk one left off.
+        assert grows[0]["rolled_back_steps"] == 0
+        summary = [e for e in events if e.get("kind") == "elastic_summary"]
+        assert summary[-1]["grows"] == 1
+        assert summary[-1]["final_world"] == 2
+        assert summary[-1]["desired_world"] == 2
+        assert summary[-1]["exit_code"] == 0
+
+        # The grown attempt saved the final checkpoint at world 2 through
+        # the two-phase path (and its commit barrier did not trust the
+        # markers attempt 0 — same world! — left in any re-saved step dir).
+        meta = ckpt.load_meta(str(run_dir / "ckpt" / "step_00000064"))
+        assert meta["step"] == 64
+        assert meta["shard_world"] == 2
+
+        # Steps 0..63 plus the final drained record: no gaps across the
+        # world-2, world-1, and grown world-2 attempts.
+        losses = all_log_losses(run_dir)
+        assert set(losses) == set(range(65))
+        assert all(np.isfinite(v) for v in losses.values())
+
+        # analyze.py folds the grow records in and gates on them.
+        r2 = subprocess.run(
+            [sys.executable, "-m", "tpu_trainer.tools.analyze",
+             str(run_dir / "supervisor.jsonl"),
+             "--compare", str(run_dir / "supervisor.jsonl")],
+            capture_output=True, text=True, env=_env(), timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        assert "PASS grow_seconds_max" in r2.stdout
+        assert "PASS elastic_regrow" in r2.stdout
+        r3 = subprocess.run(
+            [sys.executable, "-m", "tpu_trainer.tools.analyze",
+             str(run_dir / "supervisor.jsonl"),
+             "--compare", str(run_dir / "supervisor.jsonl"),
+             "--grow-tol", "1e-9"],
+            capture_output=True, text=True, env=_env(), timeout=120)
+        assert r3.returncode == 1
+        assert "FAIL grow_seconds_max" in r3.stdout
+
+    def _notice_run(self, tiny_yaml, run_dir, *, standby_hosts,
+                    env_extra=None):
+        kw = {}
+        if standby_hosts:
+            kw["standby_hosts"] = standby_hosts
+        return run_supervisor(
+            run_dir, tiny_yaml,
+            trainer_args=("--inject_fault", "preempt_notice@4",
+                          "--preempt_vote_interval", "1",
+                          "--preemption_grace_s", "60"),
+            env_extra=env_extra,
+            **kw)
+
+    def test_notice_drain_beats_deadline_and_standby_cuts_recovery(
+            self, tiny_yaml, tmp_path):
+        # A preemption notice at step 4 (rank 1, the default target) must
+        # drain PROACTIVELY: checkpoint at the step boundary, drain marker
+        # written before the notice's kill deadline, exit before any kill
+        # lands — and the reform rolls back zero steps. Run the scenario
+        # cold vs --standby_hosts 1: promotion must measurably cut
+        # recovery_seconds (the spare has already paid interpreter + jax
+        # import when the reform needs a rank). The window ends at the
+        # reformed attempt's ENTRY beat — resumed-and-ready — so the
+        # comparison isolates process startup from first-step compile,
+        # which is identical work (and run-to-run noise) in both legs.
+        results = {}
+        for label, standby in (("cold", 0), ("standby", 1)):
+            run_dir = tmp_path / label
+            r = self._notice_run(tiny_yaml, run_dir, standby_hosts=standby)
+            assert r.returncode == 0, label + ": " + r.stdout + r.stderr
+
+            events = read_jsonl(run_dir / "supervisor.jsonl")
+            deaths = [e for e in events if e.get("kind") == "host_death"]
+            assert len(deaths) == 1, (label, deaths)
+            assert deaths[0]["host"] == 1
+            assert deaths[0]["cause"] == "fault:preempt_notice"
+            assert deaths[0]["proactive"] is True
+
+            # The drain marker (the host's deregistration) landed before
+            # the notice's kill deadline — the whole point of the notice.
+            drains = flight_lib.read_drains(
+                str(run_dir / "heartbeats" / "attempt0"))
+            assert len(drains) == 1 and drains[0]["host"] == 1
+            assert drains[0]["unix"] < drains[0]["deadline_unix"]
+
+            recoveries = [e for e in events if e.get("kind") == "recovery"]
+            assert len(recoveries) == 1, (label, recoveries)
+            rec = recoveries[0]
+            assert (rec["world_before"], rec["world_after"]) == (2, 1)
+            # Proactive drain == zero lost work: the resumed step equals
+            # the drained attempt's last completed step.
+            assert rec["rolled_back_steps"] == 0, (label, rec)
+            assert rec["promoted_standbys"] == (1 if standby else 0)
+            results[label] = rec["recovery_seconds"]
+
+            assert set(all_log_losses(run_dir)) == set(range(9)), label
+
+        print(f"recovery_seconds: cold={results['cold']:.2f} "
+              f"standby={results['standby']:.2f}")
+        assert results["standby"] < results["cold"], results
 
 
 class TestPreemptionGrace:

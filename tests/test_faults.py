@@ -184,7 +184,10 @@ class TestCheckpointLifecycle:
 
     def test_corrupt_latest_quarantined_on_resume(self, tiny_yaml, tmp_path):
         ck = tmp_path / "ck"
-        killed = run_trainer(tiny_yaml, ck,
+        # Sync checkpointing: kill@5 must land AFTER step 4's commit (and
+        # its corrupt_shard hook) — with the async saver the kill races the
+        # writer thread and can win before the fault even fires.
+        killed = run_trainer(tiny_yaml, ck, "--no_async_checkpointing",
                              "--inject_fault", "corrupt_shard@4,kill@5")
         assert killed.returncode == faults.KILL_EXIT_CODE, killed.stderr
 
@@ -197,7 +200,9 @@ class TestCheckpointLifecycle:
 
     def test_truncated_meta_skipped_on_resume(self, tiny_yaml, tmp_path):
         ck = tmp_path / "ck"
-        killed = run_trainer(tiny_yaml, ck,
+        # Sync: the truncate_meta@2 hook must have run before kill@3 fires
+        # (see the corrupt_shard test above for the async race).
+        killed = run_trainer(tiny_yaml, ck, "--no_async_checkpointing",
                              "--inject_fault", "truncate_meta@2,kill@3")
         assert killed.returncode == faults.KILL_EXIT_CODE, killed.stderr
         assert os.path.getsize(ck / "step_00000002" / "meta.json") == 0

@@ -1,0 +1,291 @@
+"""Preemption-notice + grow-back plumbing units (ISSUE 9).
+
+The cheap layer under the chaos e2e in test_elastic.py: notice sources
+(file- and GCE-metadata-shaped, polled with a real local HTTP server),
+the capacity grant/consume protocol the supervisor's grow probe reads,
+drain markers, fault-target validation (fail fast at install time, not
+at fire time on one rank of a live pod), attempt-stamped commit markers
+(the grow-back 2->1->2 stale-partial-commit hazard), and the standby
+activation handshake.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_trainer.training import elastic as elastic_lib
+from tpu_trainer.utils import checkpoint as ckpt
+from tpu_trainer.utils import faults
+from tpu_trainer.utils import flight_recorder as flight_lib
+from tpu_trainer.utils import preemption
+
+
+# --- notice sources --------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestFileNoticeSource:
+    def test_absent_then_present(self, tmp_path):
+        clock = FakeClock()
+        path = tmp_path / "notice"
+        src = preemption.FileNoticeSource(str(path), poll_interval_s=1.0,
+                                          clock=clock)
+        assert src.poll() is None
+        path.write_text("")
+        clock.t += 1.0
+        rec = src.poll()
+        assert rec is not None
+        assert rec.deadline_unix is None and rec.remaining_s() is None
+
+    def test_json_deadline_and_stickiness(self, tmp_path):
+        clock = FakeClock()
+        path = tmp_path / "notice"
+        path.write_text(json.dumps({"deadline_s": 30.0}))
+        src = preemption.FileNoticeSource(str(path), poll_interval_s=1.0,
+                                          clock=clock)
+        rec = src.poll()
+        assert rec is not None and rec.deadline_unix is not None
+        assert rec.remaining_s() > 0
+        # Sticky: deleting the file does not rescind the notice (a real
+        # preemption never un-happens; flapping must not resurrect a host
+        # that already started draining).
+        path.unlink()
+        clock.t += 5.0
+        assert src.poll() is rec
+
+    def test_poll_throttled(self, tmp_path):
+        clock = FakeClock()
+        path = tmp_path / "notice"
+        src = preemption.FileNoticeSource(str(path), poll_interval_s=10.0,
+                                          clock=clock)
+        assert src.poll() is None
+        path.write_text("")
+        clock.t += 1.0  # inside the throttle window: no FS touch yet
+        assert src.poll() is None
+        clock.t += 10.0
+        assert src.poll() is not None
+
+
+class _MetadataHandler(BaseHTTPRequestHandler):
+    body = b"FALSE"
+    require_header = True
+    seen_headers = []
+
+    def do_GET(self):
+        type(self).seen_headers.append(dict(self.headers))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(type(self).body)
+
+    def log_message(self, *a):  # keep pytest output clean
+        pass
+
+
+@pytest.fixture
+def metadata_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _MetadataHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    _MetadataHandler.body = b"FALSE"
+    _MetadataHandler.seen_headers = []
+    yield f"http://127.0.0.1:{server.server_address[1]}/preempted"
+    server.shutdown()
+
+
+class TestMetadataNoticeSource:
+    def test_false_then_true(self, metadata_server):
+        clock = FakeClock()
+        src = preemption.MetadataNoticeSource(metadata_server,
+                                              poll_interval_s=1.0,
+                                              clock=clock)
+        assert src.poll() is None
+        _MetadataHandler.body = b"TRUE"
+        clock.t += 1.0
+        rec = src.poll()
+        assert rec is not None and metadata_server in rec.source
+        # The GCE metadata server rejects queries without this header.
+        assert all(h.get("Metadata-Flavor") == "Google"
+                   for h in _MetadataHandler.seen_headers)
+
+    def test_unreachable_is_not_a_notice(self):
+        clock = FakeClock()
+        src = preemption.MetadataNoticeSource("http://127.0.0.1:9/x",
+                                              poll_interval_s=1.0,
+                                              clock=clock)
+        # A dead metadata endpoint must read as "no notice", never as a
+        # preemption — else a metadata outage would drain the whole fleet.
+        assert src.poll() is None
+
+
+class TestBuildNoticeSource:
+    def test_spec_parsing(self, tmp_path):
+        assert preemption.build_notice_source(None) is None
+        assert preemption.build_notice_source("") is None
+        src = preemption.build_notice_source(f"file:{tmp_path}/n")
+        assert isinstance(src, preemption.FileNoticeSource)
+        src = preemption.build_notice_source("http://127.0.0.1:1/p")
+        assert isinstance(src, preemption.MetadataNoticeSource)
+        src = preemption.build_notice_source("metadata")
+        assert isinstance(src, preemption.MetadataNoticeSource)
+        assert src.url == preemption.GCE_METADATA_URL
+        with pytest.raises(ValueError, match="notice"):
+            preemption.build_notice_source("carrier-pigeon")
+
+
+# --- capacity protocol -----------------------------------------------------
+
+class TestCapacityFile:
+    def test_grant_accumulates_and_consume_decrements(self, tmp_path):
+        cap = str(tmp_path / "capacity.json")
+        assert preemption.read_capacity(cap) == 0
+        assert preemption.grant_capacity(cap) == 1
+        assert preemption.grant_capacity(cap, 2) == 3
+        assert preemption.consume_capacity(cap, 2) == 1
+        assert preemption.read_capacity(cap) == 1
+        assert preemption.consume_capacity(cap, 5) == 0  # floors at zero
+
+    def test_torn_file_reads_zero(self, tmp_path):
+        cap = tmp_path / "capacity.json"
+        cap.write_text('{"hosts": ')
+        # A torn grant means "no capacity", not a crashed supervisor probe.
+        assert preemption.read_capacity(str(cap)) == 0
+        assert preemption.grant_capacity(str(cap)) == 1
+
+
+# --- drain markers ---------------------------------------------------------
+
+class TestDrainMarkers:
+    def test_roundtrip(self, tmp_path):
+        flight_lib.write_drain(str(tmp_path), 1, step=7,
+                               cause="fault:preempt_notice",
+                               deadline_unix=1234.5)
+        flight_lib.write_drain(str(tmp_path), 0, step=7, cause="metadata")
+        drains = flight_lib.read_drains(str(tmp_path))
+        assert [d["host"] for d in drains] == [0, 1]
+        assert drains[1]["step"] == 7
+        assert drains[1]["deadline_unix"] == 1234.5
+        assert drains[0]["deadline_unix"] is None
+
+    def test_empty_and_torn_tolerated(self, tmp_path):
+        assert flight_lib.read_drains(str(tmp_path / "absent")) == []
+        (tmp_path / "drain_host00003.json").write_text('{"host": ')
+        assert flight_lib.read_drains(str(tmp_path)) == []
+
+
+# --- fault-target validation (satellite: fail fast at install) -------------
+
+class TestValidateTargetHost:
+    def test_bad_value_fails_at_install(self, monkeypatch):
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "banana")
+        with pytest.raises(ValueError, match="TPU_TRAINER_FAULT_HOST"):
+            faults.install("kill_host@5", process_count=2)
+
+    def test_out_of_range_fails_at_install(self, monkeypatch):
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "1,7")
+        with pytest.raises(ValueError, match="out of range"):
+            faults.install("kill_host@5", process_count=4)
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "-1")
+        with pytest.raises(ValueError, match="out of range"):
+            faults.install("kill_host@5", process_count=4)
+
+    def test_valid_and_irrelevant_specs_install(self, monkeypatch):
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "1,3")
+        faults.install("kill_host@5,preempt_notice@7", process_count=4)
+        assert faults.target_hosts(4) == (1, 3)
+        # A bad target with NO host-targeted kind in the plan is ignored:
+        # the env var is simply irrelevant to this run.
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "banana")
+        faults.install("kill@5", process_count=4)
+
+    def test_world_one_is_exempt(self, monkeypatch):
+        # The restarted shrunk run re-installs the same spec at world 1,
+        # where host-targeted faults are inert — a target that was valid
+        # at world 2 must not fail the recovery's install.
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "1")
+        faults.install("kill_host@5", process_count=1)
+        assert faults.target_hosts(1) == ()
+
+    def test_multi_target_membership(self, monkeypatch):
+        monkeypatch.setenv("TPU_TRAINER_FAULT_HOST", "0,2")
+        assert faults.targets_host(0, 3) and faults.targets_host(2, 3)
+        assert not faults.targets_host(1, 3)
+
+
+# --- attempt-stamped commit markers (grow-back hazard) ---------------------
+
+class TestAttemptStampedMarkers:
+    def test_same_world_other_attempt_markers_rejected(self, tmp_path,
+                                                       monkeypatch):
+        # The 2->1->2 hazard: attempt 0 (world 2) died mid-commit of step N
+        # leaving a same-world partial marker set; the grown attempt 2
+        # (world 2 again) re-saving step N must not see that stale barrier
+        # as satisfied — world alone cannot tell the attempts apart.
+        path = str(tmp_path / "step_00000006")
+        cdir = os.path.join(path, "commit")
+        os.makedirs(cdir)
+        monkeypatch.setenv("TPU_TRAINER_ATTEMPT", "0")
+        ckpt._mark_host_done(path, host=0, world=2)
+        ckpt._mark_host_done(path, host=1, world=2)
+        assert ckpt._markers_complete(path, 2)
+        monkeypatch.setenv("TPU_TRAINER_ATTEMPT", "2")
+        assert not ckpt._markers_complete(path, 2)
+        ckpt._mark_host_done(path, host=0, world=2)
+        ckpt._mark_host_done(path, host=1, world=2)
+        assert ckpt._markers_complete(path, 2)
+
+    def test_unstamped_runs_unaffected(self, tmp_path, monkeypatch):
+        # Outside the supervisor (no TPU_TRAINER_ATTEMPT) nothing changes:
+        # markers carry attempt None and the barrier matches None.
+        monkeypatch.delenv("TPU_TRAINER_ATTEMPT", raising=False)
+        path = str(tmp_path / "step_00000002")
+        os.makedirs(os.path.join(path, "commit"))
+        ckpt._mark_host_done(path, host=0, world=1)
+        assert ckpt._markers_complete(path, 1)
+
+
+# --- standby activation handshake ------------------------------------------
+
+class TestHoldStandby:
+    def test_returns_env_once_written(self, tmp_path):
+        path = str(tmp_path / "standby0.json")
+        env = {"PROCESS_ID": "1", "NUM_PROCESSES": "2",
+               "COORDINATOR_ADDRESS": "127.0.0.1:1234"}
+
+        def promote():
+            with open(path, "w") as fh:
+                json.dump({"env": env}, fh)
+
+        t = threading.Timer(0.1, promote)
+        t.start()
+        try:
+            got = elastic_lib.hold_standby(path, poll_interval_s=0.01)
+        finally:
+            t.cancel()
+        assert got == env
+
+    def test_empty_env_keeps_parking(self, tmp_path):
+        # A torn/empty activation must not promote with no rendezvous env.
+        path = str(tmp_path / "standby0.json")
+        with open(path, "w") as fh:
+            json.dump({"env": {}}, fh)
+
+        def promote():
+            with open(path, "w") as fh:
+                json.dump({"env": {"PROCESS_ID": "0"}}, fh)
+
+        t = threading.Timer(0.1, promote)
+        t.start()
+        try:
+            got = elastic_lib.hold_standby(path, poll_interval_s=0.01)
+        finally:
+            t.cancel()
+        assert got == {"PROCESS_ID": "0"}

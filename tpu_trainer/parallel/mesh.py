@@ -306,6 +306,19 @@ def global_any(flag: bool) -> bool:
     return bool(np.any(votes))
 
 
+def shutdown_distributed() -> None:
+    """Best-effort clean exit from the rendezvous — the proactive-drain path
+    (``utils/preemption.py`` notice → checkpoint → deregister → exit) calls
+    this so the coordinator sees an orderly departure instead of a dropped
+    connection. Failures are swallowed: the process is exiting either way,
+    and a drain must never turn into a crash over coordinator teardown."""
+    try:
+        if jax.process_count() > 1:
+            jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
 def broadcast_from_host0(pytree):
     """Host-0 → all hosts value broadcast
     (↔ ``dist.broadcast_object_list``, reference fsdp_trainer.py:469-478)."""

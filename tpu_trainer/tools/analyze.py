@@ -10,15 +10,18 @@ the goodput table, spike/rollback/recompile events, and the comms share
 of the step. ``serve`` records (benchmarks/serve_bench.py) and ``decode``
 records (benchmarks/decode_bench.py) fold into the same report, so one
 file can carry a whole train+serve CI run. The elastic supervisor's
-``supervisor.jsonl`` (``host_death`` / ``recovery`` / ``elastic_summary``
-records, see training/elastic.py) folds in too: the report shows each
-restart's detection-to-first-step recovery time. With ``--compare`` it
+``supervisor.jsonl`` (``host_death`` / ``recovery`` / ``world_grow`` /
+``elastic_summary`` records, see training/elastic.py) folds in too: the
+report shows each restart's detection-to-first-step recovery time and
+each grow-back's grant-to-first-grown-step time. With ``--compare`` it
 renders PASS/FAIL verdicts for the new run against a baseline run on
 throughput, MFU, peak HBM, final loss, serving tok/s and p99 tail
-latency, and decode-path tok/s — plus two elastic gates: an ABSOLUTE cap
-on per-restart recovery seconds (``--recovery-tol``) and a
-restart-count-regression check — and exits nonzero on any FAIL — a
-CI-usable gate over the bench trajectory (exit 0 clean, 1 regression,
+latency, and decode-path tok/s — plus four elastic gates: ABSOLUTE caps
+on per-restart recovery seconds (``--recovery-tol``) and per-grow
+re-expansion seconds (``--grow-tol``), a restart-count-regression check,
+and a failure-to-regrow check (an ``--allow_grow`` run that lost hosts
+must finish back at its desired world) — and exits nonzero on any FAIL —
+a CI-usable gate over the bench trajectory (exit 0 clean, 1 regression,
 2 unreadable/mis-schema'd input).
 
 Every record must carry the ``schema_version`` stamp MetricLogger writes;
@@ -245,23 +248,39 @@ def summarize(records: List[dict]) -> dict:
 
     deaths = by_kind.get("host_death", [])
     recoveries = by_kind.get("recovery", [])
+    grows = by_kind.get("world_grow", [])
     esummary = by_kind.get("elastic_summary", [])
-    if deaths or recoveries or esummary:
+    if deaths or recoveries or grows or esummary:
         rec_secs = [r.get("recovery_seconds") for r in recoveries
                     if r.get("recovery_seconds") is not None]
+        grow_secs = [g.get("grow_seconds") for g in grows
+                     if g.get("grow_seconds") is not None]
         summary = esummary[-1] if esummary else {}
         report["elastic"] = {
             "restarts": summary.get("restarts", len(recoveries)),
             "final_world": summary.get("final_world"),
+            "desired_world": summary.get("desired_world"),
+            "allow_grow": summary.get("allow_grow"),
             "supervisor_exit_code": summary.get("exit_code"),
             "deaths": [{"host": d.get("host"), "cause": d.get("cause")}
                        for d in deaths],
+            "proactive_drains": sum(1 for d in deaths if d.get("proactive")),
             "recovery_seconds": rec_secs,
             "recovery_seconds_total": summary.get(
                 "recovery_seconds_total", sum(rec_secs) or None),
             "recovery_seconds_max": max(rec_secs, default=None),
+            "rolled_back_steps": [r.get("rolled_back_steps")
+                                  for r in recoveries],
+            "standby_promotions": summary.get("standby_promotions"),
             "worlds": [[r.get("world_before"), r.get("world_after")]
                        for r in recoveries],
+            "grows": summary.get("grows", len(grows)),
+            "grow_seconds": grow_secs,
+            "grow_seconds_total": summary.get(
+                "grow_seconds_total", sum(grow_secs) or None),
+            "grow_seconds_max": max(grow_secs, default=None),
+            "grow_worlds": [[g.get("world_before"), g.get("world_after")]
+                            for g in grows],
         }
 
     telemetry_steps = [r.get("step") for r in train
@@ -372,6 +391,15 @@ def render(report: dict) -> List[str]:
               f" max {_fmt(el.get('recovery_seconds_max'), 1)}s"
             + (f" | supervisor exit {el['supervisor_exit_code']}"
                if el.get("supervisor_exit_code") is not None else ""))
+        if el.get("grows"):
+            gworlds = "  ".join(f"{a}→{b}" for a, b in el["grow_worlds"])
+            lines.append(
+                f"regrow  {el['grows']} grow(s)"
+                + (f" | world {gworlds}" if gworlds else "")
+                + f" | grow total {_fmt(el.get('grow_seconds_total'), 1)}s"
+                  f" max {_fmt(el.get('grow_seconds_max'), 1)}s"
+                + (f" | standby promotions {el['standby_promotions']}"
+                   if el.get("standby_promotions") else ""))
     return lines
 
 
@@ -382,6 +410,7 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             loss_tol: float = 0.05, overhead_tol: float = 0.10,
             serve_lat_tol: float = 0.25,
             recovery_tol: float = 120.0,
+            grow_tol: float = 120.0,
             pack_tol: float = 0.05) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
@@ -395,7 +424,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
     >= ``overhead_tol`` (fraction-of-wall-clock points, not relative — a
     0.1% -> 0.2% doubling is noise, 2% -> 12% is a broken overlap) FAILs.
 
-    Two elastic gates (ISSUE 7) cover chaos-lane runs:
+    Four elastic gates cover chaos-lane runs (recovery/restarts from
+    ISSUE 7, grow/regrow from ISSUE 9):
 
     - ``recovery_seconds_max`` is ABSOLUTE too, but against a fixed
       budget rather than the baseline: the slowest single host-death
@@ -407,6 +437,14 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
       should cost exactly one restart; a second one means the first
       recovery itself died). SKIP when the baseline has no elastic
       records to anchor the count.
+    - ``grow_seconds_max`` mirrors the recovery gate for the way back up:
+      the slowest single grow-back (capacity grant detected -> first
+      heartbeat at the larger world, which includes the graceful drain of
+      the smaller attempt) must stay under ``grow_tol`` seconds ABSOLUTE.
+    - ``elastic_regrow`` fails when the new run ran with ``allow_grow``,
+      lost hosts, and still finished below its desired world — capacity
+      came back (or never did) and the run stayed shrunk. SKIP when the
+      run didn't opt into growing or lost nothing.
 
     ``non_pad_frac`` is ABSOLUTE as well: the packed-data non-pad token
     fraction dropping by >= ``pack_tol`` fraction points against the
@@ -529,6 +567,42 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "new": n_restarts,
             "absolute": True,
         })
+
+    new_grow_max = get(new, "elastic", "grow_seconds_max")
+    if new_grow_max is None:
+        verdicts.append({"metric": "grow_seconds_max", "verdict": "SKIP",
+                         "base": get(base, "elastic", "grow_seconds_max"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "grow_seconds_max",
+            "verdict": "FAIL" if new_grow_max >= grow_tol - eps else "PASS",
+            "base": get(base, "elastic", "grow_seconds_max"),
+            "new": round(new_grow_max, 2),
+            "tolerance_s": grow_tol,
+            "absolute": True,
+        })
+
+    # Failure-to-regrow: a run that lost hosts under --allow_grow and
+    # finished BELOW the world it wanted never got back up — the grow
+    # probe, capacity protocol, or relaunch is broken even if every
+    # recovery individually passed.
+    n_el = new.get("elastic") if isinstance(new.get("elastic"), dict) else {}
+    wants_regrow = (n_el.get("allow_grow") and n_el.get("deaths")
+                    and n_el.get("desired_world") is not None
+                    and n_el.get("final_world") is not None)
+    if not wants_regrow:
+        verdicts.append({"metric": "elastic_regrow", "verdict": "SKIP",
+                         "base": None, "new": n_el.get("final_world")})
+    else:
+        verdicts.append({
+            "metric": "elastic_regrow",
+            "verdict": ("PASS" if n_el["final_world"] >= n_el["desired_world"]
+                        else "FAIL"),
+            "base": n_el["desired_world"],
+            "new": n_el["final_world"],
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -582,6 +656,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="ABSOLUTE gate on elastic recovery: FAIL if "
                              "any single host-death recovery in the new "
                              "run took >= this many seconds (default 120)")
+    parser.add_argument("--grow-tol", type=float, default=120.0,
+                        help="ABSOLUTE gate on elastic grow-back: FAIL if "
+                             "any single world re-expansion (grant "
+                             "detected -> first grown-world heartbeat) "
+                             "took >= this many seconds (default 120)")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -604,7 +683,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             mem_tol=args.mem_tol, loss_tol=args.loss_tol,
             overhead_tol=args.overhead_tol,
             serve_lat_tol=args.serve_lat_tol,
-            recovery_tol=args.recovery_tol, pack_tol=args.pack_tol)
+            recovery_tol=args.recovery_tol, grow_tol=args.grow_tol,
+            pack_tol=args.pack_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

@@ -46,6 +46,7 @@ from tpu_trainer.training.trainer import (
 )
 from tpu_trainer.utils import checkpoint as ckpt_lib
 from tpu_trainer.utils import faults, guards, profiling
+from tpu_trainer.utils import preemption as preemption_lib
 from tpu_trainer.utils import flight_recorder as flight_lib
 from tpu_trainer.utils import telemetry as telemetry_lib
 from tpu_trainer.utils.logging import MetricLogger, flops_per_token
@@ -201,14 +202,30 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                    help="debug: deterministic fault injection, "
                         "'kind@step[,kind@step...]' — kinds: nan_loss, "
                         "loss_spike, kill, kill_in_save, truncate_meta, "
-                        "corrupt_shard, sigterm, kill_host, hang_host "
-                        "(utils/faults.py)")
+                        "corrupt_shard, sigterm, kill_host, hang_host, "
+                        "preempt_notice, return_host (utils/faults.py)")
     p.add_argument("--preemption_grace_s", type=float, default=None,
                    help="hard deadline (seconds) for the SIGTERM exit path: "
                         "drain the in-flight async save and take the final "
                         "checkpoint within this budget, exiting 143 even if "
                         "the save had to be abandoned (0 = wait "
                         "indefinitely, the pre-elastic behavior)")
+    p.add_argument("--preempt_notice", type=str, default=None,
+                   help="proactive preemption notice source "
+                        "(utils/preemption.py): 'file:<path>', an http(s) "
+                        "GCE-metadata-shaped URL, or 'metadata' (the real "
+                        "GCE endpoint). A received notice drains at the "
+                        "next step boundary — checkpoint, deregister, exit "
+                        "143 — before the kill lands. SIGTERM stays the "
+                        "always-on fallback")
+    p.add_argument("--preempt_notice_poll_s", type=float, default=None,
+                   help="throttle for probing the notice source "
+                        "(default 1.0s; the HTTP probe is a network "
+                        "round-trip on the step path)")
+    p.add_argument("--preempt_vote_interval", type=int, default=None,
+                   help="steps between cross-host preemption/notice votes "
+                        "on multi-process runs (each vote is a collective; "
+                        "default 10). Single-process runs vote every step")
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--wandb_project", type=str, default=None,
                    help="log metrics to Weights & Biases (import-guarded)")
@@ -535,6 +552,14 @@ def resolve_configs(args, mode: str):
         "inject_fault": args.inject_fault,
         "preemption_grace_s": _pickf(args.preemption_grace_s,
                                      y_ft.get("preemption_grace_s"), 0.0),
+        "preempt_notice": _pick(args.preempt_notice,
+                                y_ft.get("preempt_notice")),
+        "preempt_notice_poll_s": _pickf(args.preempt_notice_poll_s,
+                                        y_ft.get("preempt_notice_poll_s"),
+                                        1.0),
+        "preempt_vote_interval": _picki(args.preempt_vote_interval,
+                                        y_ft.get("preempt_vote_interval"),
+                                        _PREEMPT_VOTE_INTERVAL),
         # Telemetry / goodput / early warning (utils/telemetry.py).
         "telemetry_interval": _picki(args.telemetry_interval, None, 0),
         "spike_sigma": _pickf(args.spike_sigma, None, 6.0),
@@ -824,6 +849,26 @@ def run_training(argv=None, mode: str = "ddp") -> int:
 
     import jax
 
+    # --- standby host (elastic supervisor's warm spares) ---------------
+    # A standby has paid the cold-start bill — interpreter, imports (jax is
+    # the multi-second item), arg parsing — and parks HERE, before the
+    # jax.distributed rendezvous binds coordinator/world/rank. Promotion
+    # (the supervisor writing the activation file) hands it the same env a
+    # fresh child would get, and it proceeds down the normal path.
+    standby_file = os.environ.get("TPU_TRAINER_STANDBY_FILE")
+    if standby_file:
+        from tpu_trainer.training import elastic as elastic_lib
+        print(f"standby: parked before rendezvous ({standby_file})",
+              flush=True)
+        activation = elastic_lib.hold_standby(standby_file)
+        if activation is None:
+            print("standby: supervisor gone; retiring unpromoted",
+                  flush=True)
+            return 0
+        os.environ.update(activation)
+        print(f"standby: promoted to rank {activation.get('PROCESS_ID')} "
+              f"(world {activation.get('NUM_PROCESSES')})", flush=True)
+
     if args.device:
         # Honor an explicit platform choice even when a site hook
         # pre-registered an accelerator plugin (same workaround as
@@ -859,7 +904,11 @@ def run_training(argv=None, mode: str = "ddp") -> int:
     # --- fault injection (--inject_fault debug flag; utils/faults.py) --
     installed_plan = None
     if data_opts["inject_fault"]:
-        installed_plan = faults.install(data_opts["inject_fault"])
+        # process_count makes install validate TPU_TRAINER_FAULT_HOST once,
+        # up front — a typo'd target rank must fail the run loudly, not
+        # quietly neuter the chaos fault it was meant to aim.
+        installed_plan = faults.install(data_opts["inject_fault"],
+                                        process_count=trainer.process_count)
 
     # --- goodput ledger: attribute every second of the run -------------
     ledger = telemetry_lib.GoodputLedger()
@@ -942,6 +991,12 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             min_interval_s=float(
                 os.environ.get("TPU_TRAINER_HEARTBEAT_INTERVAL_S", "0")),
             recorder=recorder,
+            # Every beat carries the step this attempt resumed at: the
+            # supervisor computes rolled-back work as (dead attempt's last
+            # beat) - (new attempt's start_step) — exactly 0 for a
+            # proactive notice drain, whose exit checkpoint IS the resume
+            # point.
+            start_step=int(state.step),
         )
 
     def dump_flight(reason: str, exc: Optional[BaseException] = None):
@@ -1026,6 +1081,40 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             preempted["at"] = time.monotonic()
 
     old_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+
+    # --- proactive preemption notice (utils/preemption.py) -------------
+    # The polled notice arrives BEFORE the kill deadline starts running
+    # (SIGTERM is the fallback that arrives after). A noticed host drains
+    # at the next vote boundary: checkpoint, write a drain marker
+    # (deregister — the supervisor reforms without counting a crash), exit.
+    notice_source = preemption_lib.build_notice_source(
+        data_opts["preempt_notice"]
+        or os.environ.get("TPU_TRAINER_PREEMPT_NOTICE"),
+        poll_interval_s=data_opts["preempt_notice_poll_s"])
+    notice = {"rec": None}
+
+    def check_notice(step: int) -> bool:
+        """Poll the notice source (and the preempt_notice fault) once per
+        step; sticky. Logs on first receipt."""
+        if notice["rec"] is not None:
+            return True
+        if faults.fire("preempt_notice", step) and faults.targets_host(
+                trainer.process_index, trainer.process_count):
+            grace = data_opts["preemption_grace_s"]
+            notice["rec"] = preemption_lib.PreemptionNotice(
+                source="fault:preempt_notice",
+                received_unix=time.time(),
+                deadline_unix=(time.time() + grace) if grace else None)
+        elif notice_source is not None:
+            notice["rec"] = notice_source.poll()
+        if notice["rec"] is not None:
+            remaining = notice["rec"].remaining_s()
+            print(f"preemption notice received ({notice['rec'].source})"
+                  + (f": {remaining:.1f}s to the kill deadline"
+                     if remaining is not None else "")
+                  + "; draining at the next step boundary", flush=True)
+            return True
+        return False
 
     # Async checkpointing (ISSUE 4): the periodic save snapshots to host and
     # returns; shards + meta commit on the saver's writer thread. At most one
@@ -1223,6 +1312,16 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             try:
                 start_step = int(state.step)
                 step = start_step
+                if heartbeat is not None:
+                    # Entry beat: start_step steps ARE completed (resumed)
+                    # when the loop starts, so this is a true beat — and it
+                    # marks the host live for the supervisor before the
+                    # first step's multi-second compile, which would
+                    # otherwise be silent. The recovery window (death →
+                    # first beat of the new attempt) therefore measures
+                    # time-to-resumed-and-ready, not compile time the dead
+                    # host would have paid too.
+                    heartbeat.beat(start_step)
                 for step in range(start_step, training_config.max_steps):
                     if faults.fire("kill", step):
                         faults.kill()
@@ -1231,19 +1330,38 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                         # SIGTERM to ourselves so the drain/grace exit path
                         # is exercised through the actual handler.
                         os.kill(os.getpid(), signal.SIGTERM)
-                    if faults.fire("kill_host", step) and (
-                            trainer.process_index
-                            == faults.target_host(trainer.process_count)):
+                    if faults.fire("kill_host", step) and faults.targets_host(
+                            trainer.process_index, trainer.process_count):
                         # Chaos lane: this rank dies hard; the others keep
                         # running until the supervisor reforms the mesh.
                         faults.kill()
-                    if faults.fire("hang_host", step) and (
-                            trainer.process_index
-                            == faults.target_host(trainer.process_count)):
+                    if faults.fire("hang_host", step) and faults.targets_host(
+                            trainer.process_index, trainer.process_count):
                         # Chaos lane: look dead without dying — only the
                         # supervisor's heartbeat-staleness check catches it.
                         if heartbeat is not None:
                             heartbeat.stop()
+                    if (faults.fire("return_host", step)
+                            and trainer.process_index == 0
+                            and int(os.environ.get("TPU_TRAINER_ATTEMPT",
+                                                   "0")) > 0):
+                        # Chaos lane: the cluster re-grants a host. Not
+                        # host-targeted — rank 0 plays the granting agent,
+                        # and it must stay live at world 1, where a shrunk
+                        # run is exactly the one that needs to grow back.
+                        # Armed only on attempt > 0: a "returned" host only
+                        # exists after a death, and async dispatch lets the
+                        # first attempt's Python loop run steps ahead of the
+                        # collective a dying peer just abandoned — an
+                        # attempt-0 grant would regrow the reform straight
+                        # into the re-armed kill fault.
+                        cap_file = os.environ.get("TPU_TRAINER_CAPACITY_FILE")
+                        if cap_file:
+                            total = preemption_lib.grant_capacity(cap_file, 1)
+                            print(f"fault return_host@{step}: capacity grant "
+                                  f"written ({total} host(s) available)",
+                                  flush=True)
+                    has_notice = check_notice(step)
                     # profiler.step returns a StepTraceAnnotation context
                     # inside the trace window (per-step grouping in the
                     # viewer), a nullcontext outside it.
@@ -1378,18 +1496,42 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     # the same step (not on the local flag, which would
                     # desynchronize the allgather).
                     vote_now = (trainer.process_count == 1
-                                or (step + 1) % _PREEMPT_VOTE_INTERVAL == 0)
-                    if vote_now and mesh_lib.global_any(preempted["hit"]):
+                                or (step + 1)
+                                % data_opts["preempt_vote_interval"] == 0)
+                    if vote_now and mesh_lib.global_any(
+                            preempted["hit"] or has_notice):
+                        proactive = not preempted["hit"]
                         if main:
-                            print("SIGTERM received: checkpointing and exiting")
+                            print("proactive drain: checkpointing and "
+                                  "exiting before the kill lands"
+                                  if proactive else
+                                  "SIGTERM received: checkpointing and "
+                                  "exiting")
                         consume(deferred.drain(), check=False)
                         grace = data_opts["preemption_grace_s"]
                         deadline = None
-                        if grace and grace > 0:
+                        rec = notice["rec"]
+                        if rec is not None and rec.deadline_unix is not None:
+                            # The notice named the kill time; anchor the
+                            # drain budget there, not at vote time.
+                            deadline = (time.monotonic()
+                                        + (rec.deadline_unix - time.time()))
+                        elif grace and grace > 0:
                             deadline = (preempted["at"] or time.monotonic()
                                         ) + grace
                         save("preempt", wait=True, deadline=deadline)
-                        dump_flight("sigterm")
+                        if rec is not None and hb_dir:
+                            # Deregister: the supervisor treats a drain
+                            # marker as a planned departure (reform without
+                            # this host), not a crash.
+                            flight_lib.write_drain(
+                                hb_dir, trainer.process_index,
+                                step=int(state.step), cause=rec.source,
+                                deadline_unix=rec.deadline_unix)
+                        dump_flight("preempt_notice" if proactive
+                                    else "sigterm")
+                        if proactive:
+                            mesh_lib.shutdown_distributed()
                         return 143
                 consume(deferred.drain())
                 save("final", wait=True)

@@ -365,6 +365,14 @@ def _write_host_shards(
     retry_io(_write, what=f"write {npz}")
 
 
+def _attempt_token() -> Optional[str]:
+    """The elastic supervisor's attempt id (``TPU_TRAINER_ATTEMPT``), or
+    None for standalone runs. Stamped into DONE markers so the commit
+    barrier only trusts markers from *this* attempt — see
+    ``_markers_complete``."""
+    return os.environ.get("TPU_TRAINER_ATTEMPT")
+
+
 def _mark_host_done(path: str, *, host: int, world: int) -> None:
     """Phase 1b: atomic per-host DONE marker — this host's shards are
     durable. Written only after ``_write_host_shards`` returned."""
@@ -374,7 +382,8 @@ def _mark_host_done(path: str, *, host: int, world: int) -> None:
 
     def _write() -> None:
         with open(marker + ".tmp", "w") as f:
-            json.dump({"host": host, "world": world}, f)
+            json.dump({"host": host, "world": world,
+                       "attempt": _attempt_token()}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(marker + ".tmp", marker)
@@ -407,7 +416,8 @@ def _await_commit(
 
 
 def _markers_complete(path: str, world: int) -> bool:
-    """All ``world`` DONE markers present *and written for this world*.
+    """All ``world`` DONE markers present, written for this world AND by
+    this attempt.
 
     Counting marker files alone is not enough: a dead attempt's leftover
     markers in the same step dir (the elastic supervisor re-saves the same
@@ -415,8 +425,17 @@ def _markers_complete(path: str, world: int) -> bool:
     before the current attempt's hosts finished writing — committing a mix
     of fresh and stale shard files. Each marker records the world it was
     written for; a marker from a different factorization is ignored, and
-    every re-saving host atomically overwrites its own marker."""
+    every re-saving host atomically overwrites its own marker.
+
+    The world stamp alone stops being sufficient once the world can GROW
+    back (``--allow_grow``): a 2→1→2 run can re-save a step whose dir holds
+    a world-2 partial commit from the attempt *before* the shrink — same
+    world, stale bytes. A grown attempt must not trust a marker it did not
+    write, so markers also carry the supervisor's attempt id
+    (``TPU_TRAINER_ATTEMPT``) and the barrier requires an exact match.
+    Standalone runs (no supervisor) carry attempt None on both sides."""
     cdir = os.path.join(path, _COMMIT_SUBDIR)
+    attempt = _attempt_token()
     for host in range(world):
         marker = os.path.join(cdir, f"host{host:05d}.done")
         try:
@@ -425,6 +444,8 @@ def _markers_complete(path: str, world: int) -> bool:
         except (OSError, ValueError):
             return False
         if not isinstance(rec, dict) or rec.get("world") != world:
+            return False
+        if rec.get("attempt") != attempt:
             return False
     return True
 

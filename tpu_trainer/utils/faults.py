@@ -31,9 +31,21 @@ programmatically (tests) or from the ``--inject_fault`` debug flag:
 - ``hang_host@N``     — chaos lane: the chosen process stops heartbeating
   at step N *without exiting* (a wedged host): only the supervisor's
   heartbeat timeout can catch it.
+- ``preempt_notice@N`` — chaos lane: the chosen process receives a
+  preemption *notice* (``utils/preemption.py``) at step N — the advance
+  warning a real scheduler delivers before the kill. The trainer drains
+  proactively: checkpoint at the next step boundary, deregister, exit
+  clean — and the supervisor reforms before the simulated kill lands.
+- ``return_host@N``   — chaos lane: at step N rank 0 writes a capacity
+  grant to the supervisor's capacity file (``TPU_TRAINER_CAPACITY_FILE``),
+  simulating a preempted host coming back — the grow probe
+  (``--allow_grow``) must re-expand the world.
 
 The host-targeted kinds fire (consume) on every rank at step N but act
-only on :func:`target_host`'s rank, so all ranks' plans stay in lockstep.
+only on :func:`target_host`'s rank(s), so all ranks' plans stay in
+lockstep. ``return_host`` is the opposite: it models the *cluster*
+granting capacity, so it acts on rank 0 (and stays live at world 1, where
+the host-targeted kinds go inert).
 
 Each fault is one-shot: it fires at its step and is consumed, so a run that
 rolls back or resumes past the step does not re-trip it — which is exactly
@@ -55,8 +67,12 @@ from typing import List, Optional, Tuple
 
 KINDS = frozenset(
     {"nan_loss", "loss_spike", "kill", "kill_in_save", "truncate_meta",
-     "corrupt_shard", "sigterm", "kill_host", "hang_host"}
+     "corrupt_shard", "sigterm", "kill_host", "hang_host",
+     "preempt_notice", "return_host"}
 )
+
+# Kinds that act on :func:`target_host`'s rank(s) only.
+HOST_TARGETED_KINDS = frozenset({"kill_host", "hang_host", "preempt_notice"})
 
 # Exit code for injected kills: mimics SIGKILL's 128+9, the way a preempted
 # or OOM-killed trainer actually dies.
@@ -113,12 +129,44 @@ class FaultPlan:
 _active: Optional[FaultPlan] = None
 
 
-def install(spec_or_plan) -> FaultPlan:
-    """Arm a fault plan process-wide (spec string or FaultPlan)."""
+def install(spec_or_plan, process_count: Optional[int] = None) -> FaultPlan:
+    """Arm a fault plan process-wide (spec string or FaultPlan).
+
+    When ``process_count`` is given and the plan contains host-targeted
+    kinds, ``TPU_TRAINER_FAULT_HOST`` is validated here, once — a typo'd
+    or out-of-range rank would otherwise make the fault silently never
+    fire (it targets a rank that does not exist) and the chaos test it
+    drives would "pass" by testing nothing."""
     global _active
-    _active = (spec_or_plan if isinstance(spec_or_plan, FaultPlan)
-               else FaultPlan.parse(spec_or_plan))
+    plan_obj = (spec_or_plan if isinstance(spec_or_plan, FaultPlan)
+                else FaultPlan.parse(spec_or_plan))
+    if process_count is not None and any(
+            kind in HOST_TARGETED_KINDS for kind, _ in plan_obj.pending()):
+        validate_target_host(process_count)
+    _active = plan_obj
     return _active
+
+
+def validate_target_host(process_count: int) -> None:
+    """Fail fast on a bad ``TPU_TRAINER_FAULT_HOST`` value (non-integer or
+    out-of-range rank). Single-process runs skip the range check — the
+    host-targeted kinds are inert there by design (see target_host)."""
+    raw = os.environ.get("TPU_TRAINER_FAULT_HOST")
+    if raw is None or process_count < 2:
+        return
+    for part in raw.split(","):
+        part = part.strip()
+        try:
+            rank = int(part)
+        except ValueError:
+            raise ValueError(
+                f"TPU_TRAINER_FAULT_HOST={raw!r}: {part!r} is not an "
+                f"integer rank")
+        if not 0 <= rank < process_count:
+            raise ValueError(
+                f"TPU_TRAINER_FAULT_HOST={raw!r}: rank {rank} out of range "
+                f"for a {process_count}-process run (valid: 0.."
+                f"{process_count - 1})")
 
 
 def clear() -> None:
@@ -145,18 +193,34 @@ def fire(kind: str, step: int) -> bool:
     return _active is not None and _active.fire(kind, step)
 
 
-def target_host(process_count: int) -> int:
-    """Which rank the host-targeted chaos faults (``kill_host``,
-    ``hang_host``) act on: ``TPU_TRAINER_FAULT_HOST`` or the highest rank —
+def target_hosts(process_count: int) -> Tuple[int, ...]:
+    """The rank(s) the host-targeted chaos faults (``kill_host``,
+    ``hang_host``, ``preempt_notice``) act on: ``TPU_TRAINER_FAULT_HOST``
+    (a rank or comma-list of ranks — two hosts dying in the same poll
+    interval is a distinct supervisor drill from one) or the highest rank —
     deliberately non-zero by default, so the dying host is never the one
     that writes meta.json (killing host 0 is a different, stricter drill
-    the env override enables). Returns -1 (matches no rank) when the run
+    the env override enables). Returns () (matches no rank) when the run
     has a single process: there is no "non-zero process" to lose, and the
     supervisor's restarted shrunk run re-arms the same ``--inject_fault``
     spec — the fault must not kill the recovery it exists to test."""
     if process_count < 2:
-        return -1
-    return int(os.environ.get("TPU_TRAINER_FAULT_HOST", process_count - 1))
+        return ()
+    raw = os.environ.get("TPU_TRAINER_FAULT_HOST")
+    if raw is None:
+        return (process_count - 1,)
+    return tuple(int(p.strip()) for p in raw.split(",") if p.strip())
+
+
+def targets_host(rank: int, process_count: int) -> bool:
+    """True when a host-targeted fault firing at this step acts on ``rank``."""
+    return rank in target_hosts(process_count)
+
+
+def target_host(process_count: int) -> int:
+    """First targeted rank, or -1 at world 1 (see target_hosts)."""
+    hosts = target_hosts(process_count)
+    return hosts[0] if hosts else -1
 
 
 def kill(exit_code: int = KILL_EXIT_CODE) -> None:

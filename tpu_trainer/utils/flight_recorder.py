@@ -139,7 +139,9 @@ class HeartbeatWriter:
     """Per-host liveness beats for the elastic run supervisor.
 
     One JSONL file per host (``heartbeat_host{k}.jsonl`` — per-host files,
-    so concurrent writers never interleave), one line per training step::
+    so concurrent writers never interleave), one line per training step,
+    plus an entry beat at loop start (``step == start_step``) so a host is
+    live before its first multi-second compile::
 
         {"kind": "heartbeat", "host": k, "pid": ..., "step": n,
          "unix": t, "schema_version": ...}
@@ -159,9 +161,15 @@ class HeartbeatWriter:
 
     def __init__(self, directory: str, *, host: int,
                  min_interval_s: float = 0.0,
-                 recorder: Optional[FlightRecorder] = None):
+                 recorder: Optional[FlightRecorder] = None,
+                 start_step: Optional[int] = None):
         self.host = int(host)
         self.min_interval_s = float(min_interval_s)
+        # start_step = the step this attempt resumed at: every beat carries
+        # it so the supervisor can compute rolled-back work exactly
+        # (last beat of the dead attempt minus the next attempt's
+        # start_step) without having to catch the first beat in flight.
+        self.start_step = None if start_step is None else int(start_step)
         self.path = os.path.join(
             directory, f"heartbeat_host{self.host:05d}.jsonl")
         self._recorder = recorder
@@ -189,6 +197,8 @@ class HeartbeatWriter:
             "step": int(step),
             "unix": now,
         }
+        if self.start_step is not None:
+            record["start_step"] = self.start_step
         if self._recorder is not None:
             self._recorder.observe(record)
         try:
@@ -224,3 +234,52 @@ def read_heartbeat(directory: str, host: int) -> Optional[dict]:
         if isinstance(rec, dict) and rec.get("kind") == "heartbeat":
             return rec
     return None
+
+
+def write_drain(directory: str, host: int, *, step: int, cause: str,
+                deadline_unix=None) -> str:
+    """Deregister ``host`` from the attempt: an atomic drain marker in the
+    heartbeat directory, written by a proactively-draining host (preemption
+    notice received) *before* it exits. The supervisor reads these to tell
+    a planned departure (reform without this host, nobody crashed) from a
+    crash (every other exit path). Per-attempt heartbeat dirs make the
+    markers self-scoping, like the beats."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"drain_host{int(host):05d}.json")
+    record = {
+        "kind": "drain",
+        "schema_version": SCHEMA_VERSION,
+        "host": int(host),
+        "pid": os.getpid(),
+        "step": int(step),
+        "cause": cause,
+        "deadline_unix": deadline_unix,
+        "unix": time.time(),
+    }
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        fh.write(json.dumps(record))
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_drains(directory: str) -> list:
+    """All drain markers of an attempt's heartbeat dir (sorted by host)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in sorted(names):
+        if not (name.startswith("drain_host") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue  # torn marker: the atomic replace makes this transient
+        if isinstance(rec, dict) and rec.get("kind") == "drain":
+            out.append(rec)
+    return out

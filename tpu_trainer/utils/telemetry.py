@@ -317,6 +317,11 @@ class GoodputLedger:
         # (training/elastic.py) — a single trainer process can't see its own
         # death — and reported from the supervisor's own ledger/JSONL.
         "recovery",
+        # Elastic grow-back: capacity-grant detection -> first step of the
+        # re-expanded world (--allow_grow). Supervisor-side, like recovery;
+        # time spent re-expanding is deliberate downtime, not a crash, so
+        # it gets its own bucket (and its own analyze gate).
+        "grow",
     )
 
     def __init__(self, clock=time.perf_counter):
