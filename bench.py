@@ -467,11 +467,12 @@ def analyze_run_jsonl(path: str) -> None:
 def run_packed(args, mesh_cfg):
     """Packed-vs-padded effective-throughput A/B (``--packed``).
 
-    Both lanes bin the SAME deterministic synthetic ragged corpus
+    All lanes bin the SAME deterministic synthetic ragged corpus
     (``data/packing.synthetic_documents``) into ``[rows, seq, 2]`` batches —
-    first-fit packing vs one-padded-document-per-row — and run the identical
-    segment-aware train step (one compile, shared shapes), so raw tok/s is
-    ~equal and the effective (non-pad) tok/s ratio isolates padding waste:
+    first-fit packing, best-fit-decreasing packing (``packed_bfd``), and
+    one-padded-document-per-row — and run the identical segment-aware train
+    step (one compile, shared shapes), so raw tok/s is ~equal and the
+    effective (non-pad) tok/s ratio isolates padding waste:
     ~seq/mean_doc_len upper bound, the packing headroom.
     """
     import jax  # noqa: F401  (platform init side effect)
@@ -511,7 +512,9 @@ def run_packed(args, mesh_cfg):
         // trainer.process_count
     mean_len = args.mean_doc_len or max(8, seq_len // 4)
     lanes = {}
-    for lane, pack in (("packed", True), ("padded", False)):
+    for lane, pack, strat in (("packed", True, "first_fit"),
+                              ("packed_bfd", True, "best_fit"),
+                              ("padded", False, "first_fit")):
         # Corpus sized so one pass covers warmup + all windows with slack;
         # the cycling iterator below makes exhaustion a non-event anyway.
         per_row = max(1, seq_len // mean_len) if pack else 1
@@ -519,7 +522,7 @@ def run_packed(args, mesh_cfg):
         loader = PackedDataLoader(
             lambda n=total: synthetic_documents(
                 n, mean_len, model_config.vocab_size, seed=17),
-            rows, seq_len, pack=pack, seed=17,
+            rows, seq_len, pack=pack, strategy=strat, seed=17,
         )
 
         def cycle(ld=loader):
@@ -554,6 +557,7 @@ def run_packed(args, mesh_cfg):
         "value": lanes["packed"]["effective_tok_per_sec"],
         "unit": "tok/s",
         "packed": lanes["packed"],
+        "packed_bfd": lanes["packed_bfd"],
         "padded": lanes["padded"],
         "effective_speedup": round(speedup, 2),
         "model_size": args.model_size,
@@ -583,8 +587,10 @@ def update_packing_md(result) -> None:
         "| Lane | tok/s | non-pad frac | effective tok/s |",
         "|---|---|---|---|",
     ]
-    for lane in ("packed", "padded"):
-        r = result[lane]
+    for lane in ("packed", "packed_bfd", "padded"):
+        r = result.get(lane)
+        if r is None:
+            continue  # JSONL from before the best-fit lane existed
         lines.append(
             f"| {lane} | {r['tok_per_sec']:,.0f} | {r['non_pad_frac']:.3f} "
             f"| {r['effective_tok_per_sec']:,.0f} |"

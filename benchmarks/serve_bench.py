@@ -1,22 +1,45 @@
-"""Serving benchmark: continuous batching vs sequential decode.
+"""Serving benchmark: continuous batching, chunked prefill, prefix caching.
 
-Replays a seeded open-loop Poisson trace through the serving engine
-(``tpu_trainer.serving``) and reports aggregate tokens/s, p50/p99 TTFT
-(arrival -> first token) and per-token latency (TPOT), KV-pool occupancy
-and preemptions — then runs the same requests as sequential batch-1
-``generate_kv`` calls, the "one request at a time" baseline continuous
-batching exists to beat.
+Replays a request trace through the serving engine (``tpu_trainer.serving``)
+and reports aggregate tokens/s, p50/p99 TTFT (arrival -> first token) and
+per-token latency (TPOT), KV-pool occupancy, preemptions, prefill-chunk
+counts and prefix-cache hit rate — then optionally runs the same requests
+as sequential batch-1 ``generate_kv`` calls, the "one request at a time"
+baseline continuous batching exists to beat.
 
-    python benchmarks/serve_bench.py [--requests 32] [--concurrency 8] \
-        [--out serve.jsonl]
+Workloads (``--workload``):
+
+- ``uniform``  — the original seeded open-loop Poisson trace.
+- ``adversarial`` — short decode-heavy requests plus a few VERY long
+  prompts arriving mid-decode: the monolithic-prefill worst case chunked
+  prefill exists to fix (each long prefill stalls every in-flight decode).
+- ``shared_prefix`` — every prompt opens with the same system-prompt
+  prefix: the recompute-per-request worst case prefix caching exists to
+  fix.
+
+``--trace FILE`` replays a recorded trace instead: JSONL, one request per
+line, ``{"prompt_len": int, "max_new": int, "arrival_time": float,
+"prefix_id": str, "prefix_len": int}`` (only ``prompt_len`` is required —
+length pairs from a real tokenizer log drop in directly; tokens are
+synthesized deterministically from ``--seed``, with requests sharing a
+``prefix_id`` sharing their first ``prefix_len`` tokens).
+``benchmarks/traces/sample_trace.jsonl`` is a checked-in example CI runs.
+
+``--ab`` runs the workload twice as an A/B pair — unchunked vs chunked
+for ``adversarial``, prefix cache off vs on for ``shared_prefix`` — and
+``--update-md`` splices the lane table into ``benchmarks/results.md``.
+
+    python benchmarks/serve_bench.py [--requests 32] [--concurrency 8]
+    python benchmarks/serve_bench.py --workload adversarial --ab --update-md
+    python benchmarks/serve_bench.py --trace benchmarks/traces/sample_trace.jsonl
     python benchmarks/serve_bench.py --smoke          # CPU CI gate
 
-Results go to stdout as a table plus one schema-versioned JSON record
-(``kind="serve"``); ``--out`` appends the record to a JSONL file that
+Results go to stdout as a table plus one schema-versioned JSON record per
+lane (``kind="serve"``); ``--out`` appends records to a JSONL file that
 ``python -m tpu_trainer.tools.analyze`` summarizes and ``--compare``
-gates. ``--smoke`` shrinks everything to a 16-request trace on a tiny
-model (CI runs it under ``JAX_PLATFORMS=cpu``) and exits nonzero when
-p99 TTFT breaks the ``--ttft-p99-gate`` bound or the trace fails to
+gates. ``--smoke`` shrinks everything to a tiny model (CI runs it under
+``JAX_PLATFORMS=cpu``), adds a chunked long-prompt adversarial case, and
+exits nonzero when p99 TTFT/TPOT break their gates or a trace fails to
 drain.
 """
 
@@ -26,8 +49,65 @@ import argparse
 import os
 import sys
 import time
+import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_RESULTS_MD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results.md")
+
+
+def _load_trace_file(path, *, vocab_size, max_seq_len, default_max_new,
+                     seed, Request, SamplingParams, np):
+    """JSONL trace -> fresh Request list. Deterministic in (file, seed):
+    tails come from per-request streams, shared prefixes from per-id
+    streams, so two requests with the same ``prefix_id`` really do share
+    their first ``prefix_len`` tokens (the prefix cache can hit)."""
+    import json
+
+    prefix_tokens = {}
+
+    def prefix(pid, n):
+        have = prefix_tokens.get(pid, [])
+        if len(have) < n:
+            rs = np.random.RandomState(
+                (zlib.crc32(str(pid).encode()) ^ seed) & 0x7FFFFFFF)
+            have = rs.randint(1, vocab_size, size=n).tolist()
+            prefix_tokens[pid] = have
+        return have[:n]
+
+    reqs = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            rec = json.loads(line)
+            plen = int(rec["prompt_len"])
+            mnew = int(rec.get("max_new", default_max_new))
+            if plen < 1 or plen + mnew > max_seq_len:
+                raise ValueError(
+                    f"{path}:{i + 1}: prompt_len {plen} + max_new {mnew} "
+                    f"does not fit max_seq_len {max_seq_len}")
+            pfx_len = min(int(rec.get("prefix_len", 0)), plen)
+            pid = rec.get("prefix_id")
+            head = prefix(pid, pfx_len) if pid is not None and pfx_len else []
+            rs = np.random.RandomState((seed + 7919 * (i + 1)) & 0x7FFFFFFF)
+            tail = rs.randint(1, vocab_size, size=plen - len(head)).tolist()
+            reqs.append(Request(
+                rid=len(reqs),
+                prompt=[int(t) for t in head + tail],
+                max_new_tokens=mnew,
+                sampling=SamplingParams(
+                    temperature=float(rec.get("temperature", 0.0)),
+                    top_k=int(rec.get("top_k", 0)),
+                    seed=int(rec.get("seed", 1000 + i)),
+                ),
+                arrival_time=float(rec.get("arrival_time", 0.0)),
+            ))
+    if not reqs:
+        raise ValueError(f"trace {path} has no requests")
+    return reqs
 
 
 def main(argv=None) -> int:
@@ -54,15 +134,44 @@ def main(argv=None) -> int:
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--max-seq-len", type=int, default=256)
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked-prefill token budget per iteration "
+                        "(0 = whole-prompt prefill)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="copy-on-write prefix sharing in the KV pool")
+    p.add_argument("--workload", default="uniform",
+                   choices=("uniform", "adversarial", "shared_prefix"))
+    p.add_argument("--trace", default=None, metavar="FILE",
+                   help="replay a recorded JSONL trace instead of a "
+                        "synthetic workload (see module docstring)")
+    p.add_argument("--long-prompt-len", type=int, default=0,
+                   help="adversarial workload: long-prompt length "
+                        "(0 = max_seq_len - max_new)")
+    p.add_argument("--n-long", type=int, default=2,
+                   help="adversarial workload: number of long prompts")
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="shared_prefix workload: shared system-prompt "
+                        "tokens (0 = half of min prompt len)")
+    p.add_argument("--ab", action="store_true",
+                   help="run the workload as an A/B lane pair: unchunked "
+                        "vs chunked (adversarial), prefix off vs on "
+                        "(shared_prefix); implies --no-baseline")
+    p.add_argument("--update-md", action="store_true",
+                   help="with --ab: splice the lane table into "
+                        "benchmarks/results.md")
     p.add_argument("--no-baseline", action="store_true",
                    help="skip the sequential generate_kv comparison")
     p.add_argument("--out", default=None,
-                   help="append the schema-versioned record to this JSONL")
+                   help="append the schema-versioned record(s) to this JSONL")
     p.add_argument("--smoke", action="store_true",
-                   help="16-request tiny-model CI gate (implies "
+                   help="tiny-model CI gate: 16-request uniform trace plus "
+                        "a chunked long-prompt adversarial case (implies "
                         "--no-baseline)")
     p.add_argument("--ttft-p99-gate", type=float, default=0.0,
                    help="seconds; > 0 gates p99 TTFT and exits 1 past it "
+                        "(--smoke defaults this to 60)")
+    p.add_argument("--tpot-p99-gate", type=float, default=0.0,
+                   help="seconds; > 0 gates p99 TPOT and exits 1 past it "
                         "(--smoke defaults this to 60)")
     args = p.parse_args(argv)
 
@@ -76,6 +185,10 @@ def main(argv=None) -> int:
         args.no_baseline = True
         if args.ttft_p99_gate == 0.0:
             args.ttft_p99_gate = 60.0
+        if args.tpot_p99_gate == 0.0:
+            args.tpot_p99_gate = 60.0
+    if args.ab:
+        args.no_baseline = True
 
     import json
 
@@ -87,6 +200,7 @@ def main(argv=None) -> int:
     from tpu_trainer.models.gpt import GPT, generate_kv
     from tpu_trainer.serving.engine import (
         ServingEngine, poisson_trace, request_metrics)
+    from tpu_trainer.serving.scheduler import Request, SamplingParams
     from tpu_trainer.utils.logging import SCHEMA_VERSION
 
     plo, phi = (int(x) for x in args.prompt_len.split(","))
@@ -100,7 +214,7 @@ def main(argv=None) -> int:
         jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
     )["params"]
 
-    def make_trace():
+    def uniform_trace():
         # Fresh Request objects each run (the engine mutates them);
         # greedy sampling so both paths do identical per-token work.
         trace = poisson_trace(
@@ -114,43 +228,154 @@ def main(argv=None) -> int:
                 r.arrival_time = 0.0
         return trace
 
-    engine = ServingEngine(
-        params, cfg, max_batch=args.concurrency,
-        block_size=args.block_size, num_blocks=args.num_blocks or None,
-        kv_int8=args.kv_int8, attention=args.attention,
-    )
-    engine.run(make_trace())          # warm-up: compiles every step shape
-    engine.reset_stats()
-    finished = engine.run(make_trace())
-    summary = engine.summary()
-    lat = request_metrics(finished)
-    drained = all(len(r.generated) >= min(r.max_new_tokens, 1)
-                  for r in finished)
+    def adversarial_trace():
+        """Short decode-heavy requests at t=0; long prompts arrive while
+        those decode, so their prefill lands mid-stream — the p99 TPOT
+        adversary. Unchunked, each long prefill stalls every decode for
+        the full prompt; chunked, for at most one chunk."""
+        long_len = args.long_prompt_len or (args.max_seq_len - args.max_new)
+        long_len = min(long_len, args.max_seq_len - args.max_new)
+        n_long = min(args.n_long, args.requests - 1)
+        rs = np.random.RandomState(args.seed)
+        trace = []
+        for i in range(args.requests - n_long):
+            plen = int(rs.randint(plo, phi + 1))
+            # Varied decode lengths desynchronize the slot waves: slots
+            # free one at a time, so the FIFO-queued longs are admitted
+            # while neighbouring slots are still mid-decode — the
+            # contention the adversary needs (uniform max_new would let
+            # whole waves finish together and the long prefills run
+            # against empty slots, stalling nobody).
+            mnew = int(rs.randint(max(2, args.max_new // 2),
+                                  args.max_new * 3 // 2 + 1))
+            trace.append(Request(
+                rid=i,
+                prompt=rs.randint(1, args.vocab, size=plen).tolist(),
+                max_new_tokens=mnew,
+                sampling=SamplingParams(temperature=0.0, seed=100 + i),
+                arrival_time=0.0,
+            ))
+        for j in range(n_long):
+            trace.append(Request(
+                rid=args.requests - n_long + j,
+                prompt=rs.randint(1, args.vocab, size=long_len).tolist(),
+                max_new_tokens=args.max_new,
+                sampling=SamplingParams(temperature=0.0, seed=900 + j),
+                arrival_time=0.05 * (j + 1),   # mid-decode arrival
+            ))
+        return trace
 
-    record = {
-        "kind": "serve",
-        "schema_version": SCHEMA_VERSION,
-        "n_requests": args.requests,
-        "concurrency": args.concurrency,
-        "rate": args.rate,
-        "block_size": args.block_size,
-        "kv_int8": bool(args.kv_int8),
-        "attention": args.attention,
-        "model": {"hidden": args.hidden, "layers": args.layers,
-                  "heads": args.heads, "vocab": args.vocab},
-        "tokens_per_s": round(summary["tokens_per_s"], 2),
-        "generated_tokens": int(summary["generated_tokens"]),
-        "wall_s": round(summary["wall_s"], 4),
-        "occupancy_mean": round(summary["occupancy_mean"], 4),
-        "occupancy_max": round(summary["occupancy_max"], 4),
-        "preemptions": int(summary["preemptions"]),
-        "prefill_iters": int(summary["prefill_iters"]),
-        "decode_iters": int(summary["decode_iters"]),
-    }
-    for name, series in lat.items():
-        if series:
-            record[f"{name}_p50_s"] = round(float(np.percentile(series, 50)), 5)
-            record[f"{name}_p99_s"] = round(float(np.percentile(series, 99)), 5)
+    def shared_prefix_trace():
+        """Every prompt opens with the same system prompt; tails differ."""
+        pfx_len = args.prefix_len or max(args.block_size, plo // 2)
+        pfx_len = min(pfx_len, plo - 1)
+        rs = np.random.RandomState(args.seed)
+        system = rs.randint(1, args.vocab, size=pfx_len).tolist()
+        trace = []
+        for i in range(args.requests):
+            plen = int(rs.randint(plo, phi + 1))
+            tail = rs.randint(1, args.vocab, size=plen - pfx_len).tolist()
+            trace.append(Request(
+                rid=i,
+                prompt=[int(t) for t in system + tail],
+                max_new_tokens=args.max_new,
+                sampling=SamplingParams(temperature=0.0, seed=100 + i),
+                arrival_time=0.0,
+            ))
+        return trace
+
+    if args.trace:
+        def make_trace():
+            return _load_trace_file(
+                args.trace, vocab_size=args.vocab,
+                max_seq_len=args.max_seq_len, default_max_new=args.max_new,
+                seed=args.seed, Request=Request,
+                SamplingParams=SamplingParams, np=np)
+        workload = f"trace:{os.path.basename(args.trace)}"
+    else:
+        make_trace = {"uniform": uniform_trace,
+                      "adversarial": adversarial_trace,
+                      "shared_prefix": shared_prefix_trace}[args.workload]
+        workload = args.workload
+
+    def run_lane(lane, prefill_chunk, prefix_cache, trace_fn=make_trace,
+                 wl=None):
+        engine = ServingEngine(
+            params, cfg, max_batch=args.concurrency,
+            block_size=args.block_size, num_blocks=args.num_blocks or None,
+            kv_int8=args.kv_int8, attention=args.attention,
+            prefill_chunk_tokens=prefill_chunk or None,
+            prefix_cache=prefix_cache,
+        )
+        engine.run(trace_fn())        # warm-up: compiles every step shape
+        engine.reset_stats()
+        finished = engine.run(trace_fn())
+        summary = engine.summary()
+        lat = request_metrics(finished)
+        drained = all(len(r.generated) >= min(r.max_new_tokens, 1)
+                      for r in finished)
+        record = {
+            "kind": "serve",
+            "schema_version": SCHEMA_VERSION,
+            "workload": wl or workload,
+            "lane": lane,
+            "n_requests": len(finished),
+            "concurrency": args.concurrency,
+            "rate": args.rate,
+            "block_size": args.block_size,
+            "kv_int8": bool(args.kv_int8),
+            "attention": args.attention,
+            "prefill_chunk": int(prefill_chunk),
+            "prefix_cache": bool(prefix_cache),
+            "model": {"hidden": args.hidden, "layers": args.layers,
+                      "heads": args.heads, "vocab": args.vocab},
+            "tokens_per_s": round(summary["tokens_per_s"], 2),
+            "generated_tokens": int(summary["generated_tokens"]),
+            "wall_s": round(summary["wall_s"], 4),
+            "occupancy_mean": round(summary["occupancy_mean"], 4),
+            "occupancy_max": round(summary["occupancy_max"], 4),
+            "preemptions": int(summary["preemptions"]),
+            "prefill_iters": int(summary["prefill_iters"]),
+            "decode_iters": int(summary["decode_iters"]),
+            "prefill_chunks": int(summary["prefill_chunks"]),
+            "prompt_tokens": int(summary["prompt_tokens"]),
+            "prefix_hit_tokens": int(summary["prefix_hit_tokens"]),
+            "prefix_hit_rate": round(summary["prefix_hit_rate"], 4),
+            "prefix_evictions": int(summary["prefix_evictions"]),
+        }
+        for name, series in lat.items():
+            if series:
+                record[f"{name}_p50_s"] = round(
+                    float(np.percentile(series, 50)), 5)
+                record[f"{name}_p99_s"] = round(
+                    float(np.percentile(series, 99)), 5)
+        return record, drained
+
+    # --- lanes --------------------------------------------------------------
+    if args.ab:
+        # Chunk default: big enough that per-iteration dispatch overhead
+        # amortizes (short prompts stay single-chunk → tok/s parity with
+        # the unchunked lane), small enough that a long prompt still
+        # splits into several chunks with decodes interleaved between.
+        chunk = args.prefill_chunk or 8 * args.block_size
+        if args.workload == "shared_prefix" and not args.trace:
+            lanes = [("no_prefix", args.prefill_chunk, False),
+                     ("prefix", args.prefill_chunk, True)]
+        else:
+            lanes = [("unchunked", 0, args.prefix_cache),
+                     ("chunked", chunk, args.prefix_cache)]
+    else:
+        lanes = [("serve", args.prefill_chunk, args.prefix_cache)]
+
+    records, all_drained = [], True
+    for lane, chunk, pfx in lanes:
+        record, drained = run_lane(lane, chunk, pfx)
+        all_drained = all_drained and drained
+        records.append(record)
+        _print_record(record)
+        print(json.dumps(record), flush=True)
+
+    record = records[-1]   # gates/baseline read the primary (last) lane
 
     if not args.no_baseline:
         # Sequential baseline: the SAME requests, one batch-1 greedy
@@ -172,7 +397,6 @@ def main(argv=None) -> int:
                 top_k=1, prompt_lens=jnp.asarray(lens[i:i + 1]),
             )
             return int(out[-1, -1])   # host read = hard sync
-
         one(0)                        # warm
         t0 = time.perf_counter()
         for i in range(len(trace)):
@@ -182,11 +406,76 @@ def main(argv=None) -> int:
         record["sequential_tokens_per_s"] = round(seq_tok_s, 2)
         record["concurrent_speedup"] = round(
             record["tokens_per_s"] / seq_tok_s, 3)
+        print(f"serial  {record['sequential_tokens_per_s']:10.1f} tok/s "
+              f"sequential generate_kv -> {record['concurrent_speedup']:.2f}x "
+              f"from batching", flush=True)
 
-    print(f"serve   {record['tokens_per_s']:10.1f} tok/s over "
+    if args.ab and len(records) == 2:
+        a, b = records
+        tok_ratio = b["tokens_per_s"] / max(a["tokens_per_s"], 1e-9)
+        line = (f"A/B     {b['lane']} vs {a['lane']}: "
+                f"tok/s x{tok_ratio:.2f}")
+        if a.get("tpot_p99_s") and b.get("tpot_p99_s"):
+            line += (f", p99 TPOT x"
+                     f"{a['tpot_p99_s'] / max(b['tpot_p99_s'], 1e-9):.2f} "
+                     f"better")
+        if b["prefix_cache"] and not a["prefix_cache"]:
+            line += f", prefix hit rate {b['prefix_hit_rate']:.2f}"
+        print(line, flush=True)
+        if args.update_md:
+            update_serving_md(workload, records)
+
+    if args.out:
+        with open(args.out, "a") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+
+    failures = []
+    if not all_drained:
+        failures.append("trace did not drain (unfinished requests)")
+    if args.ttft_p99_gate > 0:
+        p99 = record.get("ttft_p99_s")
+        if p99 is None or p99 > args.ttft_p99_gate:
+            failures.append(
+                f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
+    if args.tpot_p99_gate > 0:
+        p99 = record.get("tpot_p99_s")
+        if p99 is None or p99 > args.tpot_p99_gate:
+            failures.append(
+                f"p99 TPOT {p99}s > gate {args.tpot_p99_gate}s")
+
+    if args.smoke and not args.trace:
+        # The long-prompt adversarial case: two near-max prompts land
+        # mid-decode with chunked prefill + prefix cache on — the exact
+        # configuration the fast path exists for — gated on p99 TPOT.
+        adv_record, adv_drained = run_lane(
+            "smoke_adversarial", args.block_size, True,
+            trace_fn=adversarial_trace, wl="adversarial")
+        _print_record(adv_record)
+        print(json.dumps(adv_record), flush=True)
+        if args.out:
+            with open(args.out, "a") as fh:
+                fh.write(json.dumps(adv_record) + "\n")
+        if not adv_drained:
+            failures.append("adversarial trace did not drain")
+        p99 = adv_record.get("tpot_p99_s")
+        if p99 is None or p99 > args.tpot_p99_gate:
+            failures.append(
+                f"adversarial p99 TPOT {p99}s > gate {args.tpot_p99_gate}s")
+
+    for f in failures:
+        print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
+    return 1 if failures else 0
+
+
+def _print_record(record) -> None:
+    tag = record["lane"]
+    print(f"{tag:<8}{record['tokens_per_s']:10.1f} tok/s over "
           f"{record['n_requests']} reqs (concurrency "
           f"{record['concurrency']}, {record['generated_tokens']} tokens, "
-          f"{record['wall_s']:.2f}s)", flush=True)
+          f"{record['wall_s']:.2f}s, chunk={record['prefill_chunk'] or '-'}"
+          f", prefix={'on' if record['prefix_cache'] else 'off'})",
+          flush=True)
     if "ttft_p50_s" in record:
         print(f"TTFT    p50 {record['ttft_p50_s'] * 1e3:8.1f} ms   "
               f"p99 {record['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
@@ -195,28 +484,54 @@ def main(argv=None) -> int:
               f"p99 {record['tpot_p99_s'] * 1e3:8.1f} ms", flush=True)
     print(f"pool    occupancy mean {record['occupancy_mean']:.2f} "
           f"max {record['occupancy_max']:.2f}, "
-          f"{record['preemptions']} preemptions", flush=True)
-    if "sequential_tokens_per_s" in record:
-        print(f"serial  {record['sequential_tokens_per_s']:10.1f} tok/s "
-              f"sequential generate_kv -> {record['concurrent_speedup']:.2f}x "
-              f"from batching", flush=True)
-    print(json.dumps(record), flush=True)
+          f"{record['preemptions']} preemptions, "
+          f"{record['prefill_chunks']} prefill chunks, "
+          f"prefix hit rate {record['prefix_hit_rate']:.2f} "
+          f"({record['prefix_hit_tokens']}/{record['prompt_tokens']} "
+          f"prompt tokens)", flush=True)
 
-    if args.out:
-        with open(args.out, "a") as fh:
-            fh.write(json.dumps(record) + "\n")
 
-    failures = []
-    if not drained:
-        failures.append("trace did not drain (unfinished requests)")
-    if args.ttft_p99_gate > 0:
-        p99 = record.get("ttft_p99_s")
-        if p99 is None or p99 > args.ttft_p99_gate:
-            failures.append(
-                f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
-    for f in failures:
-        print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
-    return 1 if failures else 0
+def update_serving_md(workload, records) -> None:
+    """Splice an A/B lane table into benchmarks/results.md (one marker
+    block per workload, same mechanism as the scaling/packing tables)."""
+    start = f"<!-- serving-{workload}:start -->"
+    end = f"<!-- serving-{workload}:end -->"
+    m = records[0]["model"]
+    header = (
+        f"`python benchmarks/serve_bench.py --workload {workload} --ab` — "
+        f"hidden {m['hidden']}, layers {m['layers']}, "
+        f"{records[0]['n_requests']} reqs @ concurrency "
+        f"{records[0]['concurrency']}, block {records[0]['block_size']} "
+        f"({time.strftime('%Y-%m-%d')}).\n\n"
+    )
+    lines = [
+        "| Lane | chunk | prefix | tok/s | TTFT p99 (ms) | TPOT p99 (ms) "
+        "| hit rate | preemptions |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        lines.append(
+            f"| {r['lane']} | {r['prefill_chunk'] or '-'} "
+            f"| {'on' if r['prefix_cache'] else 'off'} "
+            f"| {r['tokens_per_s']:,.0f} "
+            f"| {(r.get('ttft_p99_s') or 0) * 1e3:.1f} "
+            f"| {(r.get('tpot_p99_s') or 0) * 1e3:.1f} "
+            f"| {r['prefix_hit_rate']:.2f} | {r['preemptions']} |"
+        )
+    block = f"{start}\n{header}" + "\n".join(lines) + f"\n{end}"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if start in text:
+        text = text.split(start)[0] + block + text.split(end)[1]
+    elif "## Serving fast path" in text:
+        text = text.replace("## Serving fast path\n",
+                            f"## Serving fast path\n\n{block}\n", 1)
+    else:
+        text += f"\n## Serving fast path\n\n{block}\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote serving table ({workload}) to {_RESULTS_MD}",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

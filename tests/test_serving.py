@@ -337,6 +337,198 @@ class TestEngineScheduling:
         assert [r.generated for r in fin] == _greedy_reference(params, PLENS)
 
 
+class TestChunkedPrefill:
+    """Chunked prefill must be invisible in the token streams: greedy
+    output bit-matches ``generate_kv`` for every chunk size, including
+    chunk=1 and chunk > prompt, with and without the prefix cache."""
+
+    @pytest.mark.parametrize("chunk", [1, 3, 8, 64])
+    def test_greedy_bit_matches_generate_kv(self, params, chunk):
+        ref = _greedy_reference(params, PLENS)
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            attention="reference",
+                            prefill_chunk_tokens=chunk)
+        fin = eng.run(_requests(PLENS), time_mode="steps")
+        assert [r.generated for r in fin] == ref
+        assert eng.cache_state.pool.occupancy == 0.0
+
+    def test_chunked_with_prefix_cache_bit_matches(self, params):
+        ref = _greedy_reference(params, PLENS)
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            attention="reference", prefill_chunk_tokens=4,
+                            prefix_cache=True)
+        fin = eng.run(_requests(PLENS), time_mode="steps")
+        assert [r.generated for r in fin] == ref
+
+    def test_decode_interleaves_with_long_prefill(self, params):
+        # The p99 TPOT contract: while a long prompt is mid-prefill and
+        # another request is decodable, prefill and decode iterations
+        # strictly alternate — no decode waits for more than one chunk.
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            attention="reference", prefill_chunk_tokens=4)
+        rs = np.random.RandomState(2)
+        short = Request(
+            rid=0, prompt=rs.randint(1, CFG.vocab_size, size=4).tolist(),
+            max_new_tokens=30,
+            sampling=SamplingParams(temperature=0.0, seed=1))
+        long_req = Request(
+            rid=1, prompt=rs.randint(1, CFG.vocab_size, size=40).tolist(),
+            max_new_tokens=4,
+            sampling=SamplingParams(temperature=0.0, seed=2))
+        eng.scheduler.add(short)
+        kinds, active = [], []
+        added = False
+        for _ in range(400):
+            if not eng.scheduler.has_work():
+                break
+            both = (long_req.status == "running" and long_req.prefilling()
+                    and short.status == "running" and not short.prefilling())
+            p0, d0 = eng.stats["prefill_iters"], eng.stats["decode_iters"]
+            eng.step()
+            kinds.append("P" if eng.stats["prefill_iters"] > p0
+                         else "D" if eng.stats["decode_iters"] > d0 else "I")
+            active.append(both)
+            if not added and len(short.generated) >= 1:
+                eng.scheduler.add(long_req)
+                added = True
+        assert added and len(long_req.generated) == 4
+        contended = "".join(k for k, b in zip(kinds, active) if b)
+        assert len(contended) >= 10        # the contention window existed
+        assert "PP" not in contended and "DD" not in contended
+
+    def test_preempt_mid_prefill_resume_identical(self, params):
+        def run(num_blocks):
+            eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                                num_blocks=num_blocks,
+                                attention="reference",
+                                prefill_chunk_tokens=3)
+            fin = eng.run(_requests(PLENS), time_mode="steps")
+            return [r.generated for r in fin], eng.scheduler.n_preemptions
+
+        roomy, p0 = run(None)
+        tight, p1 = run(5)
+        assert p0 == 0 and p1 > 0
+        assert tight == roomy == _greedy_reference(params, PLENS)
+
+    def test_int8_chunked_prefix_engine_smoke(self, params):
+        # int8 KV stays lossy (op-level tolerance gated above); chunking
+        # + prefix sharing must compose: run, drain, in-vocab tokens.
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            kv_int8=True, attention="reference",
+                            prefill_chunk_tokens=4, prefix_cache=True)
+        fin = eng.run(_requests(PLENS), time_mode="steps")
+        for r in fin:
+            assert len(r.generated) == r.max_new_tokens
+            assert all(0 <= t < CFG.vocab_size for t in r.generated)
+
+
+class TestPrefixCOW:
+    """Refcounted copy-on-write prefix sharing: pool invariants, index
+    lookup/eviction semantics, and the engine-level guarantee that a hit
+    skips exactly the cached blocks without changing any stream."""
+
+    def _cache(self, num_blocks=12, prefix=True):
+        cfg = dataclasses.replace(
+            CFG, decode_paged=True, paged_block_size=8,
+            paged_num_blocks=num_blocks, paged_max_blocks=4)
+        return PagedKVCache(cfg, slots=2, prefix_cache=prefix)
+
+    def test_refcount_invariants(self):
+        pool = BlockPool(8)
+        a = pool.alloc(2)
+        assert all(pool.refcount(b) == 1 for b in a)
+        pool.retain(a)
+        pool.free(a)                     # drops to 1: still shared
+        assert all(pool.refcount(b) == 1 for b in a)
+        assert pool.free_blocks == 5     # no reclaim while referenced
+        pool.free(a)                     # last ref: reclaimed
+        assert pool.free_blocks == 7
+        with pytest.raises(ValueError):
+            pool.free(a)                 # double free rejected
+        with pytest.raises(ValueError):
+            pool.retain(a)               # retaining a free block rejected
+
+    def test_prefix_lookup_caps_at_cow_boundary(self):
+        cache = self._cache()
+        toks = list(range(1, 25))        # 24 tokens = 3 full blocks
+        digs = cache.block_digests(toks)
+        assert len(digs) == 3
+        blocks = cache.alloc_blocks(3)
+        for d, b in zip(digs, blocks):
+            assert cache.prefix_register(d, b)
+        assert not cache.prefix_register(digs[0], blocks[0])
+        # A full-prompt match stops at (len-1)//block_size blocks: the
+        # final block stays private so the prefill cursor always lands
+        # on an unshared block (copy-on-write by construction).
+        shared, matched = cache.prefix_lookup(toks)
+        assert shared == blocks[:2] and matched == 16
+        shared, matched = cache.prefix_lookup(toks + [99] * 8)
+        assert shared == blocks and matched == 24
+        # Divergence after block 1 matches only block 1.
+        shared, matched = cache.prefix_lookup(toks[:8] + [77] * 16)
+        assert shared == blocks[:1] and matched == 8
+        cache2 = self._cache(prefix=False)
+        assert cache2.prefix_lookup(toks) == ([], 0)
+
+    def test_eviction_only_reclaims_unreferenced_lru(self):
+        cache = self._cache(num_blocks=5)   # 4 usable (block 0 = null)
+        toks = list(range(1, 25))
+        blocks = cache.alloc_blocks(3)
+        for d, b in zip(cache.block_digests(toks), blocks):
+            cache.prefix_register(d, b)
+        cache.pool.free(blocks)          # engine released; index holds on
+        assert cache.evictable_blocks == 3
+        assert cache.available_blocks == 4
+        shared, _ = cache.prefix_lookup(toks)   # LRU-touches blocks[:2]
+        cache.pool.retain(shared)        # ...and a request now shares them
+        assert cache.evictable_blocks == 1
+        got = cache.alloc_blocks(2)      # 1 free + evict the cold block
+        assert got is not None and blocks[2] in got
+        assert cache.n_prefix_evictions == 1
+        assert cache.alloc_blocks(1) is None   # shared blocks untouchable
+
+    def test_prefix_hit_skips_exactly_cached_blocks(self, params):
+        plen = 20                        # 2 full blocks + a 4-token tail
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            attention="reference", prefix_cache=True)
+        first = eng.run(_requests([plen]), time_mode="steps")
+        eng.reset_stats()
+        again = eng.run(_requests([plen]), time_mode="steps")
+        assert [r.generated for r in again] == [r.generated for r in first]
+        assert eng.scheduler.prefix_hit_tokens == 16
+        assert eng.stats["prefill_tokens"] == plen - 16
+        assert [r.generated for r in again] == _greedy_reference(
+            params, [plen])
+
+    def test_shared_prefix_divergent_tails_bit_match(self, params):
+        rs = np.random.RandomState(5)
+        system = rs.randint(1, CFG.vocab_size, size=16).tolist()
+        prompts = [system + rs.randint(1, CFG.vocab_size, size=n).tolist()
+                   for n in (4, 7, 9)]
+
+        def reqs():
+            return [Request(rid=i, prompt=list(p), max_new_tokens=8,
+                            sampling=SamplingParams(temperature=0.0,
+                                                    seed=50 + i))
+                    for i, p in enumerate(prompts)]
+
+        base_eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                                 attention="reference")
+        base = [r.generated for r in base_eng.run(reqs(),
+                                                  time_mode="steps")]
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            attention="reference", prefix_cache=True,
+                            prefill_chunk_tokens=4)
+        fin = eng.run(reqs(), time_mode="steps")
+        assert [r.generated for r in fin] == base
+        assert eng.scheduler.prefix_hit_tokens > 0
+        # After drain the pool holds exactly the index-owned (evictable)
+        # blocks — nothing leaked, nothing still pinned by a request.
+        cs = eng.cache_state
+        held = round(cs.pool.occupancy * (cs.pool.num_blocks - 1))
+        assert held == cs.evictable_blocks > 0
+
+
 @pytest.mark.slow
 class TestSoak:
     def test_1k_request_soak(self, params):
@@ -368,6 +560,39 @@ class TestServeBench:
         finally:
             sys.path.pop(0)
         assert serve_bench.main(["--smoke"]) == 0
+
+    def test_trace_replay_smoke_passes(self):
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            import serve_bench
+        finally:
+            sys.path.pop(0)
+        trace = os.path.join(REPO, "benchmarks", "traces",
+                             "sample_trace.jsonl")
+        assert serve_bench.main(
+            ["--smoke", "--trace", trace,
+             "--prefill-chunk", "8", "--prefix-cache"]) == 0
+
+    def test_trace_loader_is_deterministic_and_shares_prefixes(self):
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            from serve_bench import _load_trace_file
+        finally:
+            sys.path.pop(0)
+        path = os.path.join(REPO, "benchmarks", "traces",
+                            "sample_trace.jsonl")
+        kw = dict(vocab_size=256, max_seq_len=64, default_max_new=8,
+                  seed=0, Request=Request, SamplingParams=SamplingParams,
+                  np=np)
+        a = _load_trace_file(path, **kw)
+        b = _load_trace_file(path, **kw)
+        assert [r.prompt for r in a] == [r.prompt for r in b]
+        sys_reqs = [r for i, r in enumerate(a) if i in (0, 1, 4, 7)]
+        assert len(sys_reqs) == 4
+        head = sys_reqs[0].prompt[:16]
+        assert all(r.prompt[:16] == head for r in sys_reqs)
+        tails = {tuple(r.prompt[16:]) for r in sys_reqs}
+        assert len(tails) == len(sys_reqs)   # tails stay unique
 
     @pytest.mark.slow
     def test_gate_violation_exits_nonzero(self):
@@ -412,6 +637,16 @@ class TestAnalyzeGates:
         base = self._write(tmp_path, "base.jsonl", [self.SERVE, self.DECODE])
         bad_serve = dict(self.SERVE, tokens_per_s=500.0, ttft_p99_s=0.2)
         bad = self._write(tmp_path, "bad.jsonl", [bad_serve, self.DECODE])
+        assert analyze_main([base, "--compare", base]) == 0
+        assert analyze_main([bad, "--compare", base]) == 1
+
+    def test_prefix_hit_rate_regression_fails_gate(self, tmp_path):
+        from tpu_trainer.tools.analyze import main as analyze_main
+
+        base_rec = dict(self.SERVE, prefix_hit_rate=0.6, prefix_cache=True)
+        bad_rec = dict(self.SERVE, prefix_hit_rate=0.1, prefix_cache=True)
+        base = self._write(tmp_path, "pbase.jsonl", [base_rec])
+        bad = self._write(tmp_path, "pbad.jsonl", [bad_rec])
         assert analyze_main([base, "--compare", base]) == 0
         assert analyze_main([bad, "--compare", base]) == 1
 

@@ -91,6 +91,12 @@ class MixtureDataLoader:
         self.num_batches = num_batches
         self._cur_batch = 0
         self._resume_skip = 0
+        # Telemetry: the source of the batch most recently yielded, and
+        # cumulative per-source batch counts this process — the trainer
+        # threads last_source into the train JSONL (``data_source``) so
+        # per-source loss can be read back out of one mixed run.
+        self.last_source: Optional[str] = None
+        self.batches_by_source: Dict[str, int] = {n: 0 for n in sources}
 
     # --- cursor protocol ---------------------------------------------------
 
@@ -184,6 +190,8 @@ class MixtureDataLoader:
                         f"mixture source {name!r} yields no batches"
                     ) from None
             self._cur_batch = i + 1
+            self.last_source = name
+            self.batches_by_source[name] += 1
             yield batch
             i += 1
 

@@ -204,6 +204,15 @@ class GPTConfig:
     # jnp gather — the CPU path), "kernel" (Pallas flash-decode,
     # interpret off-TPU), "auto" = kernel on TPU, reference elsewhere.
     paged_attention: str = "auto"
+    # Chunked-prefill history width for THIS dispatch, in blocks: when a
+    # prefill chunk starts past position 0 (the request's earlier chunks
+    # or a shared prefix already sit in the pool), the chunk's queries
+    # must also attend to the first ``paged_hist_blocks`` table entries
+    # of pooled history. Static so the gather shape specializes with the
+    # width bucket; 0 = no history read — offset-0 prefill, the original
+    # monolithic path. Set per dispatch by the serving engine via
+    # dataclasses.replace; never a user knob.
+    paged_hist_blocks: int = 0
 
     # Static switch for the ragged (per-row prompt length) KV-decode path:
     # set internally by generate_kv(prompt_lens=...); uniform decode keeps
@@ -285,6 +294,11 @@ class GPTConfig:
                 raise ValueError(
                     "decode_paged needs paged_num_blocks >= 2 (block 0 is "
                     "the reserved null block) and paged_max_blocks >= 1"
+                )
+            if not 0 <= self.paged_hist_blocks <= self.paged_max_blocks:
+                raise ValueError(
+                    f"paged_hist_blocks ({self.paged_hist_blocks}) must be "
+                    f"in [0, paged_max_blocks={self.paged_max_blocks}]"
                 )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(

@@ -374,19 +374,30 @@ class CausalSelfAttention(nn.Module):
         free list so memory scales with tokens actually cached, not with
         slots * context limit (tpu_trainer/serving/paged_cache.py).
 
-        Cache-variable contract (the engine writes ``tables``/``lengths``
-        from its host-side state before every call):
+        Cache-variable contract (the engine writes ``tables``/
+        ``lengths``/``offsets`` from its host-side state before every
+        call):
 
-        - prefill (``s > 1``): rows start empty; ``lengths[r]`` is row
-          r's TRUE token count within the right-padded width (attention
-          masks beyond it; padded positions scatter into the null block
-          0). Attention runs over this call's in-flight k/v — the pool is
-          written, not read. ``lengths`` is left as-is (it already counts
-          the tokens being deposited).
+        - prefill (``s > 1``): row r's tokens are a CHUNK starting at
+          global position ``offsets[r]`` (0 = classic whole-prompt
+          prefill); ``lengths[r]`` is the row's total cached tokens
+          AFTER this chunk, so the chunk's true width is ``lengths[r] -
+          offsets[r]`` within the right-padded ``s`` (attention masks
+          beyond it; padded positions scatter into the null block 0).
+          Attention runs over this call's in-flight k/v plus — when
+          ``cfg.paged_hist_blocks > 0`` — the first ``paged_hist_blocks``
+          pooled blocks of each row, masked to positions strictly below
+          ``offsets[r]`` (the history deposited by earlier chunks or a
+          shared prefix). History k/v precede the in-flight k/v in the
+          softmax's key order, i.e. in ascending global position — the
+          same order the monolithic pass reduces in, which is what keeps
+          chunked greedy streams bit-identical. ``lengths`` is left
+          as-is (it already counts the tokens deposited so far).
         - decode (``s == 1``): the new token writes at position
           ``lengths[r]`` of row r's table and attends over ``lengths[r]
           + 1`` pooled positions (flash_decode kernel or the jnp
           reference, ``cfg.paged_attention``); ``lengths`` increments.
+          ``offsets`` is ignored (broadcast as zeros).
         """
         cfg = self.config
         b, s, h, d = q.shape
@@ -412,14 +423,20 @@ class CausalSelfAttention(nn.Module):
                 jnp.float32)
         tb = self.variable("cache", "tables", jnp.zeros, (b, mb), jnp.int32)
         ln = self.variable("cache", "lengths", jnp.zeros, (b,), jnp.int32)
-        tables, lengths = tb.value, ln.value
+        of = self.variable("cache", "offsets", jnp.zeros, (b,), jnp.int32)
+        tables, lengths, offsets = tb.value, ln.value, of.value
 
         cos, sin = rope_tables(mb * bsz, d, cfg.rope_theta)
         if s == 1:
             pos = lengths[:, None]                               # [b, 1]
         else:
-            pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
-        q, k = apply_rotary_pos_emb(q, k, cos[pos], sin[pos])
+            # Chunked prefill: row r's local position i sits at global
+            # position offsets[r] + i (offsets is all-zero for the
+            # classic whole-prompt pass).
+            pos = offsets[:, None] + jax.lax.broadcasted_iota(
+                jnp.int32, (b, s), 1)
+        rope_pos = jnp.minimum(pos, mb * bsz - 1)  # pad rows may overrun
+        q, k = apply_rotary_pos_emb(q, k, cos[rope_pos], sin[rope_pos])
 
         # Scatter this call's k/v into the pool: position p of row r lands
         # at (tables[r, p // bsz], p % bsz). Prefill padding (p >= the
@@ -452,11 +469,13 @@ class CausalSelfAttention(nn.Module):
             scale_k = scale_v = None
 
         if s > 1:
-            # Prefill attention runs over the in-flight k/v directly
-            # (everything attendable was just computed): ragged causal,
-            # keeping each pad query's self position so its (never-read)
-            # softmax row stays finite — same rationale as the contiguous
-            # ragged path above.
+            # Prefill attention runs over the in-flight k/v (everything
+            # from this chunk was just computed): ragged causal in LOCAL
+            # coordinates — the chunk holds lengths - offsets true tokens
+            # — keeping each pad query's self position so its (never-read)
+            # softmax row stays finite, same rationale as the contiguous
+            # ragged path above. With offsets == 0 this is exactly the
+            # original whole-prompt mask.
             kf, vf = k, v
             if kvh != h:
                 from tpu_trainer.ops.attention import repeat_kv
@@ -466,12 +485,50 @@ class CausalSelfAttention(nn.Module):
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * scale
             q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
             k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            chunk_len = (lengths - offsets)[:, None, None]
             allowed = (k_pos[None] <= q_pos[None]) & (
-                (k_pos[None] < lengths[:, None, None])
+                (k_pos[None] < chunk_len)
                 | (k_pos[None] == q_pos[None])
             )
             scores = jnp.where(
                 allowed[:, None], scores, jnp.finfo(scores.dtype).min)
+            hb = cfg.paged_hist_blocks
+            if hb > 0:
+                # Non-zero-offset chunk: also attend the pooled history
+                # (earlier chunks / shared prefix) — the first hb table
+                # entries of each row, masked to global positions below
+                # offsets[r]. Reading the post-scatter pool is safe: the
+                # positions this chunk just wrote are >= offsets and
+                # masked out here (the in-flight path covers them).
+                from tpu_trainer.utils.quant import dequantize_kv_int8
+
+                htab = tables[:, :hb]                       # [b, hb]
+                hk = pool_k[htab].reshape(b, hb * bsz, kvh, d)
+                hv = pool_v[htab].reshape(b, hb * bsz, kvh, d)
+                if int8:
+                    hks = scale_k[htab].reshape(b, hb * bsz, kvh, nbq)
+                    hvs = scale_v[htab].reshape(b, hb * bsz, kvh, nbq)
+                    hk = dequantize_kv_int8(hk, hks, q.dtype)
+                    hv = dequantize_kv_int8(hv, hvs, q.dtype)
+                else:
+                    hk = hk.astype(q.dtype)
+                    hv = hv.astype(q.dtype)
+                if kvh != h:
+                    from tpu_trainer.ops.attention import repeat_kv
+
+                    hk, hv = repeat_kv(hk, hv, h)
+                h_scores = jnp.einsum("bqhd,bkhd->bhqk", q, hk) * scale
+                h_pos = jax.lax.broadcasted_iota(
+                    jnp.int32, (b, hb * bsz), 1)
+                h_allowed = h_pos < offsets[:, None]        # [b, hb*bsz]
+                h_scores = jnp.where(
+                    h_allowed[:, None, None], h_scores,
+                    jnp.finfo(h_scores.dtype).min)
+                # History keys come FIRST: ascending global position,
+                # the same reduce order as the monolithic pass — the
+                # bit-exactness contract of chunked prefill.
+                scores = jnp.concatenate([h_scores, scores], axis=-1)
+                vf = jnp.concatenate([hv, vf], axis=1)
             weights = jax.nn.softmax(
                 scores.astype(jnp.float32), axis=-1).astype(q.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", weights, vf)

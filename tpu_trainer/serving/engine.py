@@ -3,23 +3,37 @@
 The engine owns a fixed slot batch (``max_batch`` rows). Every iteration
 the scheduler picks ONE of:
 
-- **prefill** — the requests admitted this iteration run a full forward
-  over prompt + generated-so-far (width bucketed to a power of two so
-  nearby shapes share a compile). Feeding generated tokens too is what
-  makes recompute-preemption exact: a resumed request is
-  indistinguishable from one that was never interrupted — same cache
-  contents, same next sampling step.
-- **decode** — every running request advances one token in a single
-  ``[slots, 1]`` forward.
+- **prefill** — requests mid-prefill feed ``seq[cursor:cursor+chunk]``
+  (width bucketed to a power of two so nearby shapes share a compile)
+  at their global positional offset; with ``prefill_chunk_tokens`` set,
+  a long prompt is split across iterations that alternate with decode
+  steps, so no decode iteration waits more than one chunk. Chunks past
+  offset 0 also attend the pooled history written by earlier chunks (or
+  a shared prefix) through a static ``hist_blocks``-wide table gather.
+  Feeding generated tokens too on re-admission is what makes
+  recompute-preemption exact: a resumed request is indistinguishable
+  from one that was never interrupted — same cache contents, same next
+  sampling step.
+- **decode** — every running request that finished prefill advances one
+  token in a single ``[slots, 1]`` forward.
 
 Both steps are one jitted dispatch including sampling (per-request
 temperature / top-k / seed, ``serving/sampling.py``). The only
-persistent device state is the KV block pools; block tables and lengths
-are re-broadcast from the scheduler's host mirrors into the cache pytree
-*inside* the jit, so scheduling never syncs the device. Idle and
-non-prefilled rows have zeroed table rows and length 0: their writes
-land in reserved block 0 and their sampled tokens are ignored host-side,
-which keeps every step unpredicated over the full slot batch.
+persistent device state is the KV block pools; block tables, lengths
+and chunk offsets are re-broadcast from the scheduler's host mirrors
+into the cache pytree *inside* the jit, so scheduling never syncs the
+device. Idle and non-stepped rows have zeroed table rows and length 0:
+their writes land in reserved block 0 and their sampled tokens are
+ignored host-side (a mid-prefill chunk's sampled token is likewise
+discarded — only the final chunk's draw, made at the same (seed, token
+index) as an unchunked pass, is consumed), which keeps every step
+unpredicated over the full slot batch.
+
+``prefix_cache=True`` turns on copy-on-write prefix sharing in the
+block pool (serving/paged_cache.py): after each chunk the engine
+publishes newly completed full PROMPT blocks under their chained
+content digest, and admission starts later identical prompts past the
+shared blocks entirely.
 
 ``python -m tpu_trainer.serving.engine`` replays a seeded open-loop
 Poisson arrival trace against a synthetic checkpoint and prints the
@@ -67,6 +81,8 @@ class ServingEngine:
         attention: str = "auto",
         eos_id: Optional[int] = None,
         watermark_blocks: int = 0,
+        prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache: bool = False,
         clock=time.perf_counter,
     ):
         if max_blocks_per_request is None:
@@ -90,22 +106,27 @@ class ServingEngine:
         self.max_batch = max_batch
         self.eos_id = eos_id
         self.clock = clock
-        self.cache_state = PagedKVCache(self.config, max_batch)
+        self.prefix_cache = prefix_cache
+        self.cache_state = PagedKVCache(
+            self.config, max_batch, prefix_cache=prefix_cache
+        )
         self.scheduler = Scheduler(
-            self.cache_state, watermark_blocks=watermark_blocks
+            self.cache_state, watermark_blocks=watermark_blocks,
+            prefill_chunk_tokens=prefill_chunk_tokens,
         )
         self.device_cache = init_paged_cache(self.config, max_batch)
         self._model = GPT(self.config)
         self._step_jit = jax.jit(
-            functools.partial(_engine_step, self._model),
-            static_argnames=("k_cap", "prefill"),
+            functools.partial(_engine_step, self.config),
+            static_argnames=("k_cap", "prefill", "hist_blocks"),
         )
         self._k_cap = 1
         self._iters = 0
         self._t0 = None
         self.stats: Dict[str, float] = {
             "prefill_iters": 0, "decode_iters": 0, "idle_iters": 0,
-            "prefill_tokens": 0, "generated_tokens": 0,
+            "prefill_tokens": 0, "prefill_chunks": 0,
+            "generated_tokens": 0,
             "occupancy_sum": 0.0, "occupancy_samples": 0,
             "occupancy_max": 0.0,
         }
@@ -118,6 +139,9 @@ class ServingEngine:
         self._iters = 0
         self._t0 = None
         self.scheduler.n_preemptions = 0
+        self.scheduler.prefix_hit_tokens = 0
+        self.scheduler.prompt_tokens = 0
+        self.cache_state.n_prefix_evictions = 0
         self.wall_elapsed = 0.0
         for k in self.stats:
             self.stats[k] = 0.0 if isinstance(self.stats[k], float) else 0
@@ -149,26 +173,42 @@ class ServingEngine:
     def _forward(self, reqs: List[Request], *, prefill: bool) -> List[Request]:
         slots = self.max_batch
         cs = self.cache_state
+        # Only the stepped rows carry real tables: other running
+        # requests' rows are nulled so this pass cannot touch their
+        # blocks (a mid-prefill row in a decode pass would otherwise
+        # take a length-0 write into its first real block).
+        tables = np.zeros_like(cs.tables)
+        lengths = np.zeros((slots,), np.int32)
+        offsets = np.zeros((slots,), np.int32)
+        hist_blocks = 0
         if prefill:
-            width = _bucket_pow2(max(r.context_len() for r in reqs))
+            width = _bucket_pow2(max(r.prefill_chunk for r in reqs))
             width = min(width, cs.capacity_tokens())
             ids = np.zeros((slots, width), np.int32)
-            # Only the prefilled rows carry real tables: running requests'
-            # rows are nulled so this pass cannot touch their blocks.
-            tables = np.zeros_like(cs.tables)
-            lengths = np.zeros((slots,), np.int32)
+            max_cursor = 0
             for r in reqs:
                 seq = r.prompt + r.generated
-                ids[r.slot, : len(seq)] = seq
+                cur, n = r.prefill_cursor, r.prefill_chunk
+                ids[r.slot, :n] = seq[cur:cur + n]
                 tables[r.slot] = cs.tables[r.slot]
-                lengths[r.slot] = len(seq)
-                self.stats["prefill_tokens"] += len(seq)
+                lengths[r.slot] = cur + n
+                offsets[r.slot] = cur
+                max_cursor = max(max_cursor, cur)
+                self.stats["prefill_tokens"] += n
+                self.stats["prefill_chunks"] += 1
+            if max_cursor > 0:
+                # Static history width (blocks), pow2-bucketed so chunk
+                # resumes at nearby depths share a compile. 0 keeps the
+                # original no-history prefill computation bit-for-bit.
+                hist_blocks = min(
+                    _bucket_pow2(cs.blocks_for(max_cursor), lo=1),
+                    cs.max_blocks,
+                )
         else:
             ids = np.zeros((slots, 1), np.int32)
-            tables = cs.tables
-            lengths = np.zeros((slots,), np.int32)
             for r in reqs:
                 ids[r.slot, 0] = (r.prompt + r.generated)[-1]
+                tables[r.slot] = cs.tables[r.slot]
                 lengths[r.slot] = r.cached_tokens()
         temps = np.zeros((slots,), np.float32)
         topks = np.zeros((slots,), np.int32)
@@ -184,17 +224,30 @@ class ServingEngine:
 
         self.device_cache, tokens = self._step_jit(
             self.params, self.device_cache,
-            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(ids),
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(offsets), jnp.asarray(ids),
             jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(keys),
             jnp.asarray(steps), k_cap=self._k_cap, prefill=prefill,
+            hist_blocks=hist_blocks,
         )
         tokens = np.asarray(tokens)
 
         now = self._now()
         finished: List[Request] = []
         for r in reqs:
+            if prefill:
+                r.prefill_cursor += r.prefill_chunk
+                cs.lengths[r.slot] = r.prefill_cursor
+                if self.prefix_cache:
+                    self._register_prefix_blocks(r)
+                if r.prefilling():
+                    # Mid-prefill chunk: the sampled draw is discarded —
+                    # the final chunk redraws at the same (seed, token
+                    # index), so the stream matches an unchunked pass.
+                    continue
             tok = int(tokens[r.slot])
             r.generated.append(tok)
+            r.token_times.append(now)
             self.stats["generated_tokens"] += 1
             # Cache now holds everything fed this pass (not the new token).
             cs.lengths[r.slot] = r.context_len() - 1
@@ -207,6 +260,22 @@ class ServingEngine:
                 self.scheduler.retire(r)
                 finished.append(r)
         return finished
+
+    def _register_prefix_blocks(self, r: Request) -> None:
+        """Publish the request's newly completed full PROMPT blocks in
+        the prefix index (shared-prefix blocks are already there; the
+        register is a no-op on an existing digest)."""
+        cs = self.cache_state
+        bsz = cs.block_size
+        done = min(r.prefill_cursor, len(r.prompt)) // bsz
+        if done <= r._blocks_registered:
+            return
+        if r._prompt_digests is None:
+            r._prompt_digests = cs.block_digests(r.prompt)
+        blocks = cs.slot_blocks(r.slot)
+        for i in range(r._blocks_registered, done):
+            cs.prefix_register(r._prompt_digests[i], blocks[i])
+        r._blocks_registered = done
 
     def _now(self) -> float:
         if self._t0 is None:
@@ -261,6 +330,13 @@ class ServingEngine:
         s["occupancy_mean"] = s.pop("occupancy_sum") / n
         s["preemptions"] = self.scheduler.n_preemptions
         s["iters"] = self._iters
+        s["prompt_tokens"] = self.scheduler.prompt_tokens
+        s["prefix_hit_tokens"] = self.scheduler.prefix_hit_tokens
+        s["prefix_hit_rate"] = (
+            self.scheduler.prefix_hit_tokens
+            / max(1, self.scheduler.prompt_tokens)
+        )
+        s["prefix_evictions"] = self.cache_state.n_prefix_evictions
         if getattr(self, "wall_elapsed", 0):
             s["wall_s"] = self.wall_elapsed
             s["tokens_per_s"] = s["generated_tokens"] / self.wall_elapsed
@@ -268,11 +344,15 @@ class ServingEngine:
 
 
 def _engine_step(
-    model, params, cache, tables, lengths, ids,
+    config, params, cache, tables, lengths, offsets, ids,
     temps, topks, keys, steps, *, k_cap: int, prefill: bool,
+    hist_blocks: int,
 ) -> Tuple[dict, jax.Array]:
     """One jitted engine step: broadcast host scheduling state into the
-    cache pytree, forward, gather each row's last real logit, sample."""
+    cache pytree, forward, gather each row's last real logit, sample.
+    ``hist_blocks`` is the static chunked-prefill history width — the
+    model is built per trace with it baked into the config, so each
+    (width bucket, history bucket) pair compiles once."""
 
     def put(path, x):
         key = getattr(path[-1], "key", None)
@@ -280,8 +360,11 @@ def _engine_step(
             return jnp.broadcast_to(tables, x.shape)
         if key == "lengths":
             return jnp.broadcast_to(lengths, x.shape)
+        if key == "offsets":
+            return jnp.broadcast_to(offsets, x.shape)
         return x
 
+    model = GPT(dataclasses.replace(config, paged_hist_blocks=hist_blocks))
     cache = jax.tree_util.tree_map_with_path(put, cache)
     (logits, _), vars_out = model.apply(
         {"params": params, "cache": cache}, ids, decode=True,
@@ -289,7 +372,8 @@ def _engine_step(
     )
     if prefill:
         last = jnp.take_along_axis(
-            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+            logits, jnp.maximum(lengths - offsets - 1, 0)[:, None, None],
+            axis=1,
         )[:, 0]
     else:
         last = logits[:, 0]
@@ -337,17 +421,27 @@ def poisson_trace(
 
 
 def request_metrics(reqs: Sequence[Request]) -> Dict[str, List[float]]:
-    """Per-request latency series (same time axis the engine ran on):
-    TTFT = first token minus arrival; TPOT = mean inter-token time over
-    the remaining tokens."""
+    """Latency series (same time axis the engine ran on): TTFT = first
+    token minus arrival, one sample per request; TPOT = every individual
+    inter-token gap (a.k.a. inter-token latency). Per-GAP samples are the
+    point: a monolithic prefill landing mid-decode stalls every in-flight
+    stream for the whole prompt, which a per-request MEAN averages away —
+    the p99 of the gaps is where that tail lives (and what chunked
+    prefill is for). Falls back to the mean-gap estimate for requests
+    recorded without per-token timestamps."""
     ttft, tpot = [], []
     for r in reqs:
         if r.first_token_at is None:
             continue
         ttft.append(r.first_token_at - r.arrival_time)
-        n_rest = len(r.generated) - 1
-        if n_rest > 0 and r.finished_at is not None:
-            tpot.append((r.finished_at - r.first_token_at) / n_rest)
+        if len(r.token_times) >= 2:
+            tpot.extend(
+                b - a for a, b in zip(r.token_times, r.token_times[1:])
+            )
+        elif not r.token_times:
+            n_rest = len(r.generated) - 1
+            if n_rest > 0 and r.finished_at is not None:
+                tpot.append((r.finished_at - r.first_token_at) / n_rest)
     return {"ttft": ttft, "tpot": tpot}
 
 
@@ -367,6 +461,11 @@ def _main() -> int:
     p.add_argument("--num-blocks", type=int, default=0,
                    help="KV pool blocks (0 = size for max_batch full contexts)")
     p.add_argument("--kv-int8", action="store_true")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked-prefill token budget per iteration "
+                        "(0 = whole-prompt prefill)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="copy-on-write prefix sharing in the block pool")
     p.add_argument("--attention", default="auto",
                    choices=("auto", "reference", "kernel"))
     p.add_argument("--temperature", type=float, default=1.0)
@@ -394,6 +493,8 @@ def _main() -> int:
         block_size=args.block_size,
         num_blocks=args.num_blocks or None,
         kv_int8=args.kv_int8, attention=args.attention,
+        prefill_chunk_tokens=args.prefill_chunk or None,
+        prefix_cache=args.prefix_cache,
     )
     trace = poisson_trace(
         args.requests, vocab_size=args.vocab, rate=args.rate,

@@ -1,34 +1,56 @@
-"""Paged KV cache: a block pool with free-list allocation + host mirrors.
+"""Paged KV cache: a refcounted block pool with free-list allocation,
+host mirrors, and a copy-on-write prefix index.
 
 The device side is the flax cache collection the paged decode path
 creates (``models/gpt.py _paged_decode_attention``): per-layer k/v pools
 ``[num_blocks, block_size, kvh, head_dim]`` (fp or int8 + scales), block
-tables ``[slots, max_blocks]`` and lengths ``[slots]``. The pools are
-the only *persistent* device state — tables and lengths are re-broadcast
-from the host mirrors kept here before every jitted step, so all
-scheduling (allocation, reclaim, preemption) is plain deterministic
-Python with zero device syncs.
+tables ``[slots, max_blocks]``, lengths ``[slots]`` and chunk offsets
+``[slots]``. The pools are the only *persistent* device state — tables,
+lengths and offsets are re-broadcast from the host mirrors kept here
+before every jitted step, so all scheduling (allocation, reclaim,
+preemption, prefix sharing) is plain deterministic Python with zero
+device syncs.
 
 Block 0 is reserved as the null block: unallocated table entries point
 at it, and the model's scatter redirects masked writes (prefill padding,
 idle slots) there. Reads always mask by length, so its garbage is never
 observed — this is what lets the scatter and the jitted step run
 unpredicated over the full slot batch.
+
+**Prefix caching** (``prefix_cache=True``): full blocks of a prompt are
+content-addressed by a chained digest (blake2b over the parent block's
+digest + the block's token ids — so a block's identity pins its whole
+left context). A new request whose leading full blocks hit the index
+shares those physical blocks instead of re-prefilling them; sharing is
+copy-on-write *by construction*: the matched length is always rounded
+down to a block boundary strictly inside the prompt, so every write a
+request ever makes (remaining prefill + decode) lands in blocks it
+allocated privately. The index itself holds one reference per entry —
+a block is reclaimable only when its refcount reaches zero, and index
+entries whose block is otherwise unreferenced form the LRU eviction
+pool that backstops allocation.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import List, Optional, Tuple
 
 import numpy as np
 
 
 class BlockPool:
-    """Free-list allocator over ``num_blocks`` pool blocks (id 0 reserved).
+    """Refcounted free-list allocator over ``num_blocks`` pool blocks
+    (id 0 reserved).
 
     LIFO free list with deterministic order: the same request sequence
     always produces the same block ids — part of the engine's
-    deterministic-replay contract.
+    deterministic-replay contract. ``alloc`` hands out blocks at
+    refcount 1; ``retain`` adds a reference (prefix sharing); ``free``
+    drops one and only returns the block to the free list when the
+    count hits zero, so a shared block is never reclaimed while any
+    request (or the prefix index) still points at it.
     """
 
     def __init__(self, num_blocks: int):
@@ -37,6 +59,7 @@ class BlockPool:
         self.num_blocks = num_blocks
         # pop() hands out ascending ids on a fresh pool.
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref = np.zeros((num_blocks,), np.int32)
 
     @property
     def free_blocks(self) -> int:
@@ -50,27 +73,47 @@ class BlockPool:
     def occupancy(self) -> float:
         return self.used_blocks / (self.num_blocks - 1)
 
+    def refcount(self, bid: int) -> int:
+        return int(self._ref[bid])
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Allocate ``n`` blocks, or None (untouched pool) if short."""
+        """Allocate ``n`` blocks at refcount 1, or None (untouched pool)
+        if short."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        self._ref[out] = 1
+        return out
+
+    def retain(self, ids) -> None:
+        """Add one reference to each (already-allocated) block."""
+        for bid in ids:
+            if not 0 < bid < self.num_blocks:
+                raise ValueError(f"retaining invalid block id {bid}")
+            if self._ref[bid] == 0:
+                raise ValueError(f"retain of free block {bid}")
+            self._ref[bid] += 1
 
     def free(self, ids) -> None:
+        """Drop one reference per block; refcount-0 blocks return to the
+        free list. Freeing an already-free block raises (double free)."""
         for bid in ids:
             if not 0 < bid < self.num_blocks:
                 raise ValueError(f"freeing invalid block id {bid}")
-            if bid in self._free:
+            if self._ref[bid] == 0:
                 raise ValueError(f"double free of block {bid}")
-            self._free.append(bid)
+            self._ref[bid] -= 1
+            if self._ref[bid] == 0:
+                self._free.append(bid)
 
 
 class PagedKVCache:
-    """Host mirrors (tables, lengths, pool) for one engine's slot batch."""
+    """Host mirrors (tables, lengths, offsets, pool, prefix index) for
+    one engine's slot batch."""
 
-    def __init__(self, config, slots: int):
+    def __init__(self, config, slots: int, *, prefix_cache: bool = False):
         if not config.decode_paged:
             raise ValueError("PagedKVCache needs config.decode_paged=True")
         self.config = config
@@ -81,6 +124,11 @@ class PagedKVCache:
         self.tables = np.zeros((slots, self.max_blocks), np.int32)
         self.lengths = np.zeros((slots,), np.int32)
         self._n_blocks = np.zeros((slots,), np.int32)  # allocated per slot
+        # Prefix index: chained block digest -> block id, in LRU order
+        # (oldest first). Each entry holds one pool reference.
+        self.prefix_cache = prefix_cache
+        self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
+        self.n_prefix_evictions = 0
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens``."""
@@ -91,7 +139,7 @@ class PagedKVCache:
         return self.max_blocks * self.block_size
 
     def assign(self, slot: int, block_ids: List[int]) -> None:
-        """Install a fresh allocation into an empty slot's table row."""
+        """Install an allocation into an empty slot's table row."""
         assert self._n_blocks[slot] == 0, f"slot {slot} not released"
         n = len(block_ids)
         assert n <= self.max_blocks
@@ -109,8 +157,88 @@ class PagedKVCache:
         return [int(b) for b in self.tables[slot, :self._n_blocks[slot]]]
 
     def release(self, slot: int) -> None:
-        """Return a slot's blocks to the pool and null its table row."""
+        """Drop the slot's references (blocks shared with the prefix
+        index or other slots survive) and null its table row."""
         self.pool.free(self.slot_blocks(slot))
         self.tables[slot] = 0
         self.lengths[slot] = 0
         self._n_blocks[slot] = 0
+
+    # -- prefix index ------------------------------------------------------
+
+    def block_digests(self, tokens: List[int]) -> List[bytes]:
+        """Chained content digests of ``tokens``' FULL blocks: digest[i]
+        = blake2b(digest[i-1] + block i's token bytes), so equal digests
+        imply equal token prefixes up to and including block i."""
+        bsz = self.block_size
+        out: List[bytes] = []
+        parent = b""
+        for i in range(len(tokens) // bsz):
+            blk = np.asarray(tokens[i * bsz:(i + 1) * bsz], np.int32)
+            parent = hashlib.blake2b(
+                parent + blk.tobytes(), digest_size=16).digest()
+            out.append(parent)
+        return out
+
+    def prefix_lookup(self, prompt: List[int]) -> Tuple[List[int], int]:
+        """Longest indexed prefix of ``prompt``, as ``(block_ids,
+        matched_tokens)``. The match is capped at the last full block
+        strictly inside the prompt (at least the final prompt token is
+        always prefilled — its logit seeds generation), which also makes
+        sharing copy-on-write by construction: the requester's first
+        write starts at a block boundary in a private block. Hits touch
+        the LRU order. Returns ``([], 0)`` when the index is off."""
+        if not self.prefix_cache:
+            return [], 0
+        k_max = max(0, (len(prompt) - 1) // self.block_size)
+        shared: List[int] = []
+        for dig in self.block_digests(prompt[:k_max * self.block_size]):
+            bid = self._prefix.get(dig)
+            if bid is None:
+                break
+            self._prefix.move_to_end(dig)
+            shared.append(bid)
+        return shared, len(shared) * self.block_size
+
+    def prefix_register(self, digest: bytes, block_id: int) -> bool:
+        """Publish a freshly filled full block under its digest. The
+        index takes its own reference. No-op (False) when the digest is
+        already indexed — concurrent identical prompts that both missed
+        keep their private copies — or when the index is off."""
+        if not self.prefix_cache or digest in self._prefix:
+            return False
+        self.pool.retain([block_id])
+        self._prefix[digest] = block_id
+        return True
+
+    @property
+    def evictable_blocks(self) -> int:
+        """Index entries whose block is referenced by the index alone."""
+        return sum(1 for bid in self._prefix.values()
+                   if self.pool.refcount(bid) == 1)
+
+    @property
+    def available_blocks(self) -> int:
+        """Free blocks plus what LRU eviction could reclaim — the
+        admission budget."""
+        return self.pool.free_blocks + self.evictable_blocks
+
+    def alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """``pool.alloc`` with LRU prefix eviction as the backstop: pop
+        index entries (oldest first) whose block only the index holds —
+        refcount-1 entries; blocks shared with live requests are never
+        reclaimed — until the free list covers ``n``. An evicted parent
+        makes its still-indexed children unreachable (the chained digest
+        walk stops early); they age out of the LRU in turn."""
+        while self.pool.free_blocks < n:
+            victim = None
+            for dig, bid in self._prefix.items():
+                if self.pool.refcount(bid) == 1:
+                    victim = dig
+                    break
+            if victim is None:
+                return None
+            bid = self._prefix.pop(victim)
+            self.pool.free([bid])
+            self.n_prefix_evictions += 1
+        return self.pool.alloc(n)

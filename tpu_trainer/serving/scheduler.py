@@ -1,29 +1,42 @@
 """Continuous-batching scheduler (Orca-style iteration-level scheduling).
 
 Decisions happen per *iteration*, not per request-batch: every engine
-step is either ONE prefill over the requests admitted this iteration or
-ONE single-token decode over everything running — finished requests
-retire and release blocks immediately, and a newly admitted request
-joins the very next decode batch instead of waiting for the oldest
-request in flight to drain (the static-batching failure mode).
+step is either ONE prefill pass or ONE single-token decode over
+everything running — finished requests retire and release blocks
+immediately, and a newly admitted request joins the very next decode
+batch instead of waiting for the oldest request in flight to drain (the
+static-batching failure mode).
 
 Policies, all deterministic host-side Python over ``PagedKVCache``'s
 mirrors (no device syncs):
 
-- **Admission** (FIFO, by free-block budget): the head of the waiting
-  queue is admitted when a slot is free and the pool covers the blocks
-  its current context needs plus ``watermark_blocks``. Head-of-line
-  blocking is deliberate — arrival order is completion-fairness here.
+- **Admission** (FIFO, by block budget): the head of the waiting queue
+  is admitted when a slot is free and the pool (free blocks plus what
+  LRU prefix eviction can reclaim) covers the blocks its current context
+  needs plus ``watermark_blocks``. With prefix caching on, admission
+  first matches the prompt against the prefix index: matched full blocks
+  are shared (refcounted, copy-on-write by construction) and the
+  request's ``prefill_cursor`` starts past them — only the remainder is
+  ever prefilled. Head-of-line blocking is deliberate — arrival order is
+  completion-fairness here.
+- **Chunked prefill** (``prefill_chunk_tokens``): a prefill iteration
+  feeds at most that many prompt tokens, split FIFO over the requests
+  still mid-prefill (each carries a ``prefill_cursor``). When both
+  mid-prefill and decodable requests exist, prefill and decode
+  iterations strictly alternate, so no decode step ever waits for more
+  than one chunk — the p99 TPOT contract. ``None`` (default) keeps the
+  original whole-prompt-per-iteration behavior exactly.
 - **Decode growth**: a running request crossing a block boundary
   allocates one block just-in-time.
 - **Preemption** (recompute-style, when the pool runs dry): the
   latest-admitted running request frees everything and goes back to the
   FRONT of the waiting queue; on re-admission it re-prefills prompt +
-  generated-so-far in one pass. Sampling is keyed by (seed, token
-  index) — serving/sampling.py — so the resumed continuation is
-  token-identical to the uninterrupted one.
-- **Retirement**: EOS or max_new_tokens; blocks return to the free list
-  the same iteration.
+  generated-so-far (minus whatever the prefix index still covers) from a
+  reset cursor. Sampling is keyed by (seed, token index) —
+  serving/sampling.py — so the resumed continuation is token-identical
+  to the uninterrupted one, chunked or not.
+- **Retirement**: EOS or max_new_tokens; the request's block references
+  drop the same iteration (shared blocks survive in the prefix index).
 """
 
 from __future__ import annotations
@@ -62,6 +75,18 @@ class Request:
     preemptions: int = 0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Wall-clock emission time of every generated token (inter-token-gap
+    # telemetry: ``engine.request_metrics`` derives TPOT from the diffs).
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    # Chunked-prefill cursor: tokens of (prompt + generated-at-admission)
+    # already resident in the cache — via earlier chunks or a prefix-index
+    # hit. The request decodes once cursor reaches prefill_target.
+    prefill_cursor: int = 0
+    prefill_target: int = 0
+    prefill_chunk: int = 0             # tokens to feed THIS iteration
+    prefix_hit_tokens: int = 0         # prompt tokens skipped at admission
+    _blocks_registered: int = 0        # prompt blocks published to the index
+    _prompt_digests = None             # lazily built chained block digests
     _key = None                        # lazily built [2] uint32 PRNG key
 
     def context_len(self) -> int:
@@ -73,6 +98,9 @@ class Request:
         token is NOT cached yet — it is the next decode step's input."""
         n = self.context_len()
         return n - 1 if self.generated else n
+
+    def prefilling(self) -> bool:
+        return self.prefill_cursor < self.prefill_target
 
     def key(self):
         if self._key is None:
@@ -86,14 +114,21 @@ class Scheduler:
     """Iteration-level scheduler over one ``PagedKVCache`` slot batch."""
 
     def __init__(self, cache: PagedKVCache, *, watermark_blocks: int = 0,
-                 max_prefill_rows: Optional[int] = None):
+                 max_prefill_rows: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None):
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError(f"prefill_chunk_tokens={prefill_chunk_tokens}")
         self.cache = cache
         self.watermark = watermark_blocks
         self.max_prefill_rows = max_prefill_rows or cache.slots
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(cache.slots))
+        self._last_was_prefill = False
         self.n_preemptions = 0
+        self.prefix_hit_tokens = 0
+        self.prompt_tokens = 0         # prompt tokens over all admissions
 
     # -- queue interface ---------------------------------------------------
 
@@ -114,43 +149,84 @@ class Scheduler:
 
     # -- the per-iteration decision ---------------------------------------
 
-    def schedule(self) -> Tuple[str, List[Request]]:
-        """Decide this iteration: ``("prefill", admitted)`` when the head
-        of the queue fits the budget (prefill has priority — it is what
-        keeps slots full), else ``("decode", running)``, else
-        ``("idle", [])``."""
+    def _admit(self) -> List[Request]:
+        """FIFO admission of the waiting-queue head while slots and the
+        block budget (free + prefix-evictable, minus the watermark)
+        last. A prefix-index hit shares the matched blocks and starts
+        the cursor past them."""
         admitted: List[Request] = []
         while (self.waiting and self._free_slots
                and len(admitted) < self.max_prefill_rows):
             req = self.waiting[0]
-            need = self.cache.blocks_for(req.context_len())
-            if need + self.watermark > self.cache.pool.free_blocks:
+            ctx = req.context_len()
+            shared, matched = self.cache.prefix_lookup(req.prompt)
+            need = self.cache.blocks_for(ctx) - len(shared)
+            if need + self.watermark > self.cache.available_blocks:
                 break
             self.waiting.popleft()
-            blocks = self.cache.pool.alloc(need)
-            assert blocks is not None  # guarded by the free_blocks check
+            fresh = self.cache.alloc_blocks(need)
+            assert fresh is not None  # guarded by the budget check
+            if shared:
+                self.cache.pool.retain(shared)
             slot = self._free_slots.pop(0)
-            self.cache.assign(slot, blocks)
+            self.cache.assign(slot, shared + fresh)
+            self.cache.lengths[slot] = matched
             req.slot = slot
             req.status = "running"
+            req.prefill_cursor = matched
+            req.prefill_target = ctx
+            req.prefix_hit_tokens = matched
+            req._blocks_registered = matched // self.cache.block_size
+            self.prefix_hit_tokens += matched
+            self.prompt_tokens += len(req.prompt)
             self.running.append(req)
             admitted.append(req)
-        if admitted:
-            return "prefill", admitted
-        if self.running:
-            return "decode", list(self.running)
+        return admitted
+
+    def schedule(self) -> Tuple[str, List[Request]]:
+        """Decide this iteration. Unchunked: ``("prefill", admitted)``
+        when the queue head fits the budget (prefill has priority — it
+        is what keeps slots full), else ``("decode", running)``, else
+        ``("idle", [])``. Chunked: mid-prefill requests get chunks up to
+        the token budget, and prefill/decode iterations alternate
+        whenever both kinds of work exist. Each returned prefill request
+        has ``prefill_chunk`` set to the tokens to feed now."""
+        self._admit()
+        prefilling = [r for r in self.running if r.prefilling()]
+        decodable = [r for r in self.running if not r.prefilling()]
+        if prefilling and decodable and self.prefill_chunk_tokens:
+            do_prefill = not self._last_was_prefill
+        else:
+            do_prefill = bool(prefilling)
+        if do_prefill:
+            budget = self.prefill_chunk_tokens or float("inf")
+            batch: List[Request] = []
+            for r in prefilling[:self.max_prefill_rows]:
+                if budget <= 0:
+                    break
+                n = int(min(r.prefill_target - r.prefill_cursor, budget))
+                r.prefill_chunk = n
+                budget -= n
+                batch.append(r)
+            self._last_was_prefill = True
+            return "prefill", batch
+        self._last_was_prefill = False
+        if decodable:
+            return "decode", decodable
         return "idle", []
 
     def ensure_decode_blocks(self) -> List[Request]:
-        """Pre-decode block growth: every running request about to write
-        at a block boundary gets one block, preempting from the back of
-        the admission order when the pool is dry. Returns the requests
-        that actually decode this iteration (preemption victims drop
-        out — including, worst case, the requester itself)."""
+        """Pre-decode block growth: every decodable request about to
+        write at a block boundary gets one block, preempting from the
+        back of the admission order when the pool is dry. Returns the
+        requests that actually decode this iteration (preemption victims
+        drop out — including, worst case, the requester itself)."""
         stepped: List[Request] = []
         for req in list(self.running):
             if req.status != "running":
                 continue  # preempted as an earlier request's victim
+            if req.prefilling():
+                continue  # mid-prefill rows never decode
             pos = req.cached_tokens()
             n_blocks = len(self.cache.slot_blocks(req.slot))
             if pos == n_blocks * self.cache.block_size:
@@ -163,7 +239,7 @@ class Scheduler:
 
     def _alloc_with_preemption(self, n: int, requester: Request):
         while True:
-            got = self.cache.pool.alloc(n)
+            got = self.cache.alloc_blocks(n)
             if got is not None:
                 return got
             victim = self.running[-1]
@@ -175,9 +251,14 @@ class Scheduler:
 
     def preempt(self, victim: Request) -> None:
         """Recompute-preemption: free everything, requeue at the FRONT so
-        re-admission preserves arrival order among the preempted."""
+        re-admission preserves arrival order among the preempted. The
+        prefill cursor resets — re-admission re-derives it (prompt +
+        generated-so-far, minus any prefix-index hit)."""
         self._vacate(victim)
         victim.status = "waiting"
+        victim.prefill_cursor = 0
+        victim.prefill_target = 0
+        victim.prefill_chunk = 0
         victim.preemptions += 1
         self.n_preemptions += 1
         self.waiting.appendleft(victim)

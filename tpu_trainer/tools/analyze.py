@@ -231,7 +231,10 @@ def summarize(records: List[dict]) -> dict:
             "tokens_per_s", "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
             "tpot_p99_s", "occupancy_mean", "occupancy_max", "preemptions",
             "sequential_tokens_per_s", "concurrent_speedup", "n_requests",
-            "concurrency") if s.get(k) is not None}
+            "concurrency", "workload", "lane", "prefill_chunk",
+            "prefix_cache", "prefill_chunks", "prefix_hit_rate",
+            "prefix_hit_tokens", "prompt_tokens",
+            "prefix_evictions") if s.get(k) is not None}
 
     decodes = by_kind.get("decode", [])
     if decodes:
@@ -281,6 +284,24 @@ def summarize(records: List[dict]) -> dict:
             "grow_seconds_max": max(grow_secs, default=None),
             "grow_worlds": [[g.get("world_before"), g.get("world_after")]
                             for g in grows],
+        }
+
+    # Per-source loss: mixture runs tag each train record with the source
+    # that produced its batch (``data_source``), so one mixed run yields a
+    # loss curve per corpus — the signal mixture weights are tuned from.
+    by_src: Dict[str, List[float]] = {}
+    for r in train:
+        src = r.get("data_source")
+        if src is not None and r.get("loss") is not None:
+            by_src.setdefault(str(src), []).append(float(r["loss"]))
+    if by_src:
+        report["sources"] = {
+            src: {
+                "n": len(ls),
+                "loss": _stats(ls),
+                "final_loss": _percentile(ls[-5:], 50),
+            }
+            for src, ls in sorted(by_src.items())
         }
 
     telemetry_steps = [r.get("step") for r in train
@@ -374,6 +395,20 @@ def render(report: dict) -> List[str]:
             f" | preemptions {s.get('preemptions')}"
             + (f" | {_fmt(s.get('concurrent_speedup'))}x vs sequential"
                if s.get("concurrent_speedup") is not None else ""))
+        if s.get("prefill_chunk") or s.get("prefix_cache"):
+            lines.append(
+                f"serve   chunk {s.get('prefill_chunk') or '-'}"
+                f" ({s.get('prefill_chunks') or 0} chunks)"
+                f" | prefix hit rate {_fmt(s.get('prefix_hit_rate'))}"
+                f" ({s.get('prefix_hit_tokens') or 0}"
+                f"/{s.get('prompt_tokens') or 0} prompt tokens,"
+                f" {s.get('prefix_evictions') or 0} evictions)")
+    src = report.get("sources")
+    if src:
+        parts = "  ".join(
+            f"{name} {_fmt(v['loss']['p50'], 4)} (n={v['n']})"
+            for name, v in src.items())
+        lines.append(f"sources p50 loss by data_source: {parts}")
     d = report.get("decode")
     if d:
         tbl = "  ".join(f"{k} {_fmt(v, 0)}"
@@ -473,6 +508,13 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
         ("serve_tok_per_sec", ("serve", "tokens_per_s"), "higher", tok_tol),
         ("serve_ttft_p99_s", ("serve", "ttft_p99_s"), "lower", serve_lat_tol),
         ("serve_tpot_p99_s", ("serve", "tpot_p99_s"), "lower", serve_lat_tol),
+        # Prefix-cache effectiveness: a hit rate dropping against the
+        # baseline means sharing broke (digest change, eviction bug, cursor
+        # regression). SKIPs when either run didn't serve with the cache on
+        # (older records carry no hit rate; a zero baseline is skipped by
+        # the b == 0 guard below rather than dividing by it).
+        ("serve_prefix_hit_rate",
+         ("serve", "prefix_hit_rate"), "higher", serve_lat_tol),
         ("decode_kv_tok_per_sec",
          ("decode", "kv_best_tok_per_sec"), "higher", tok_tol),
         ("effective_tok_per_sec_p50",

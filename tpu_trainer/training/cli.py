@@ -138,6 +138,12 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
     p.add_argument("--max_open_bins", type=int, default=None,
                    help="packing: max simultaneously open bins before the "
                         "oldest is flushed (default 8)")
+    p.add_argument("--pack_strategy", type=str, default=None,
+                   choices=("first_fit", "best_fit"),
+                   help="packing bin selection: first_fit (stream order) or "
+                        "best_fit (best-fit-decreasing over a lookahead "
+                        "window — fewer stranded bin tails; default "
+                        "first_fit)")
     p.add_argument("--mask_doc_boundaries", action="store_true", default=None,
                    help="concatenating text stream: derive segment ids from "
                         "EOS positions so attention/loss never leak across "
@@ -515,6 +521,8 @@ def resolve_configs(args, mode: str):
                                      y_data.get("pack_sequences"), False)),
         "max_open_bins": _picki(args.max_open_bins,
                                 y_data.get("max_open_bins"), 8),
+        "pack_strategy": _pick(args.pack_strategy,
+                               y_data.get("pack_strategy")) or "first_fit",
         "mask_doc_boundaries": bool(_pick(args.mask_doc_boundaries,
                                           y_data.get("mask_doc_boundaries"),
                                           False)),
@@ -601,7 +609,8 @@ def parse_mixture_spec(spec: str) -> dict:
 
 
 def _packed_synthetic_loader(rows, seq_len, vocab_size, num_batches, seed,
-                             feed_rank, feed_world, max_open_bins, pack=True):
+                             feed_rank, feed_world, max_open_bins, pack=True,
+                             strategy="first_fit"):
     """Packed loader over a deterministic synthetic ragged corpus — the
     dummy dataset's packed counterpart (and the bench's --packed input).
     Documents stride across feed ranks so hosts pack disjoint rows."""
@@ -623,7 +632,7 @@ def _packed_synthetic_loader(rows, seq_len, vocab_size, num_batches, seed,
 
     return PackedDataLoader(
         doc_fn, rows, seq_len, max_open_bins=max_open_bins, pack=pack,
-        seed=seed, num_batches=num_batches,
+        strategy=strategy, seed=seed, num_batches=num_batches,
     )
 
 
@@ -653,7 +662,8 @@ def _packed_text_loader(data_opts, rows, seq_len, feed_rank, feed_world,
     )
     train = PackedDataLoader(
         ds.iter_documents, rows, seq_len,
-        max_open_bins=data_opts["max_open_bins"], seed=seed,
+        max_open_bins=data_opts["max_open_bins"],
+        strategy=data_opts.get("pack_strategy", "first_fit"), seed=seed,
     )
     eval_loader = None
     if holdout_every:
@@ -702,11 +712,13 @@ def build_dataloaders(data_opts, trainer: Trainer, model_config: GPTConfig):
                 rows, c.max_seq_len, model_config.vocab_size,
                 data_opts["num_batches"], c.seed + 1234, feed_rank,
                 feed_world, data_opts["max_open_bins"],
+                strategy=data_opts.get("pack_strategy", "first_fit"),
             )
             eval_loader = _packed_synthetic_loader(
                 rows, c.max_seq_len, model_config.vocab_size,
                 data_opts["eval_batches"], c.seed + 4321, feed_rank,
                 feed_world, data_opts["max_open_bins"],
+                strategy=data_opts.get("pack_strategy", "first_fit"),
             )
             return train, eval_loader
         from tpu_trainer.data.dummy import create_dummy_dataloader
@@ -798,6 +810,7 @@ def _build_mixture(data_opts, trainer, model_config, rows, feed_rank,
                     rows, c.max_seq_len, model_config.vocab_size,
                     data_opts["num_batches"], sub_seed, feed_rank,
                     feed_world, data_opts["max_open_bins"],
+                    strategy=data_opts.get("pack_strategy", "first_fit"),
                 )
             else:
                 from tpu_trainer.data.dummy import create_dummy_dataloader
@@ -1207,15 +1220,22 @@ def run_training(argv=None, mode: str = "ddp") -> int:
 
     # --- the step loop (reference ddp_trainer.py:582-616) --------------
     data_iter = iter(train_loader)
+    # Per-source loss telemetry (mixture loaders): sources are recorded at
+    # PULL time in a FIFO — the device prefetcher pulls ahead of the
+    # consuming step, but pull order == consume order, so popping one entry
+    # per consumed batch re-aligns them exactly. source_by_step then feeds
+    # the (window-lagged) metric log as the `data_source` extra.
+    source_fifo = []
+    source_by_step = {}
 
     def next_batch():
         nonlocal data_iter
         try:
-            return next(data_iter)
+            b = next(data_iter)
         except StopIteration:
             data_iter = iter(train_loader)  # new epoch
             try:
-                return next(data_iter)
+                b = next(data_iter)
             except StopIteration:
                 raise SystemExit(
                     "the dataset yields zero batches for this configuration: "
@@ -1225,12 +1245,19 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                     f"{training_config.max_seq_len} tokens). Use a larger "
                     "dataset or reduce batch_size/grad_accum."
                 ) from None
+        src = getattr(train_loader, "last_source", None)
+        if src is not None:
+            source_fifo.append(src)
+        return b
 
     # Device prefetch (ISSUE 4): the feed owns the trainer-consumed cursor
     # (data/device_prefetch.py docstring) — every checkpoint/rollback reads
     # feed.state_dict(), never the raw loader's. place binds late so an LR
     # backoff's rebuilt trainer is picked up without respawning the feed.
     def make_feed():
+        # Batches buffered in a discarded feed were pulled but never
+        # consumed; their FIFO entries would desync the source alignment.
+        source_fifo.clear()
         return DevicePrefetcher(
             next_batch,
             place=lambda b: trainer.place_batch(b),
@@ -1273,7 +1300,10 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         """Log, spike-check, and guard each matured metric entry.
         ``check=False`` (exit paths) logs without raising."""
         for mstep, mmetrics in entries:
-            rec = logger.log(mstep, mmetrics)
+            src = source_by_step.pop(mstep, None)
+            rec = logger.log(
+                mstep, mmetrics,
+                extra=None if src is None else {"data_source": src})
             if not check:
                 continue
             if spike is not None and rec is not None:
@@ -1371,6 +1401,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
                             # when device_prefetch_depth > 0 — the H2D copy
                             # ran under the previous step's compute.
                             batch = feed.next()
+                        if source_fifo:
+                            source_by_step[step] = source_fifo.pop(0)
                         tel_step = bool(
                             telemetry_interval
                             and (step + 1) % telemetry_interval == 0)
