@@ -61,10 +61,23 @@ def _build_parser():
                         "(auto: fused <= 2048, split beyond)")
     p.add_argument("--remat", type=int, default=None,
                    help="default: on for medium/large/xl")
+    p.add_argument("--mesh", default=None, choices=("auto",),
+                   help="'auto' runs the mesh auto-planner "
+                        "(tpu_trainer.parallel.planner) over every feasible "
+                        "six-axis split, benches the winner, and logs the "
+                        "kind:\"mesh_plan\" record with measured-vs-"
+                        "predicted step time; mutually exclusive with "
+                        "explicit --mesh-* flags")
+    p.add_argument("--hbm-gb", "--hbm_gb", dest="hbm_gb", type=float,
+                   default=None,
+                   help="per-device HBM budget in GiB for --mesh auto "
+                        "pruning (default: the device's reported limit; "
+                        "no pruning on CPU)")
     p.add_argument("--mesh-data", type=int, default=None)
     p.add_argument("--mesh-fsdp", type=int, default=None)
     p.add_argument("--mesh-tensor", type=int, default=1)
     p.add_argument("--mesh-sequence", type=int, default=1)
+    p.add_argument("--mesh-expert", type=int, default=1)
     p.add_argument("--mesh-stage", type=int, default=1)
     p.add_argument("--strategy", default=None,
                    help="replicated | zero2 | zero3 (reference spellings ok)")
@@ -169,30 +182,12 @@ def _parse_model_flags(pairs):
     return out
 
 
-def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
-              remat, mesh_cfg, strategy, devices=None, offload=False,
-              offload_dtype="float32", num_experts=0, moe_top_k=1,
-              model_flags=None, carry_cast=True,
-              opt_state_dtype="float32", offload_budget_gb=0.0,
-              checkpoint_every=0, stream=False, prefetch_depth=2,
-              device_prefetch_depth=2):
-    """One measured config -> result dict. ``batch_size`` is per data shard
-    (global batch scales with the mesh, the reference's DDP semantics)."""
-    import jax
-    import numpy as np
-
-    from tpu_trainer.data.device_prefetch import DevicePrefetcher
-    from tpu_trainer.data.dummy import create_dummy_dataloader
-    from tpu_trainer.data.prefetch import Prefetcher
+def _bench_model_config(model_size, *, seq_len, use_flash, remat,
+                        num_experts=0, moe_top_k=1, model_flags=None):
+    """The bench's GPTConfig for a preset/size — shared by the measured run
+    and the mesh auto-planner so both price the same geometry."""
     from tpu_trainer.models.config import GPTConfig
-    from tpu_trainer.parallel.mesh import make_mesh
-    from tpu_trainer.training.config import TrainingConfig
-    from tpu_trainer.training.trainer import ParallelConfig, Trainer
-    from tpu_trainer.utils import telemetry as telemetry_lib
-    from tpu_trainer.utils.logging import flops_per_token, memory_stats, mfu
 
-    mesh = make_mesh(mesh_cfg, devices=devices)
-    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
     # Full reference-default dropout: the flash kernel implements
     # attention-weight dropout in-kernel (counter-based mask), so the
     # flash memory profile holds with dropout active.
@@ -221,6 +216,70 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         import dataclasses as _dc
 
         model_config = _dc.replace(model_config, **model_flags)
+    return model_config
+
+
+_OPT_STATE_BYTES = {"float32": 4, "bfloat16": 2, "int8": 1}
+
+
+def _auto_plan(args, n_devices, default_strategy="replicated"):
+    """--mesh auto: rank every feasible six-axis split for the bench's
+    model/batch geometry and return the winning ``mesh_plan`` record."""
+    import jax
+
+    from tpu_trainer.parallel import planner as planner_lib
+
+    model_config = _bench_model_config(
+        args.model_size, seq_len=args.seq_len, use_flash=bool(args.flash),
+        remat=_remat(args), num_experts=args.num_experts,
+        moe_top_k=args.moe_top_k,
+        model_flags=_parse_model_flags(args.model_flag))
+    # The CPU SPMD partitioner cannot lower the GPipe stage shard_map
+    # (PartitionId rejection), so correctness-mode planning must not hand
+    # back a mesh the trainer then crashes on. Real TPUs plan all six axes.
+    exclude = () if jax.devices()[0].platform == "tpu" else ("stage",)
+    try:
+        record = planner_lib.plan(
+            model_config, n_devices,
+            global_rows=args.batch_size * n_devices,
+            max_seq_len=args.seq_len, grad_accum=args.accum,
+            strategy=args.strategy or default_strategy,
+            hbm_gb=args.hbm_gb,
+            opt_state_bytes=_OPT_STATE_BYTES.get(args.opt_state_dtype, 4),
+            carry_cast=bool(args.carry_cast), exclude_axes=exclude)
+    except planner_lib.NoFeasiblePlanError as e:
+        raise SystemExit(f"--mesh auto: {e}")
+    record["auto"] = True
+    return record
+
+
+def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
+              remat, mesh_cfg, strategy, devices=None, offload=False,
+              offload_dtype="float32", num_experts=0, moe_top_k=1,
+              model_flags=None, carry_cast=True,
+              opt_state_dtype="float32", offload_budget_gb=0.0,
+              checkpoint_every=0, stream=False, prefetch_depth=2,
+              device_prefetch_depth=2, plan_record=None, hbm_gb=None):
+    """One measured config -> result dict. ``batch_size`` is per data shard
+    (global batch scales with the mesh, the reference's DDP semantics)."""
+    import jax
+    import numpy as np
+
+    from tpu_trainer.data.device_prefetch import DevicePrefetcher
+    from tpu_trainer.data.dummy import create_dummy_dataloader
+    from tpu_trainer.data.prefetch import Prefetcher
+    from tpu_trainer.parallel.mesh import make_mesh
+    from tpu_trainer.training.config import TrainingConfig
+    from tpu_trainer.training.trainer import ParallelConfig, Trainer
+    from tpu_trainer.utils import telemetry as telemetry_lib
+    from tpu_trainer.utils.logging import flops_per_token, memory_stats, mfu
+
+    mesh = make_mesh(mesh_cfg, devices=devices)
+    on_tpu = next(iter(mesh.devices.flat)).platform == "tpu"
+    model_config = _bench_model_config(
+        model_size, seq_len=seq_len, use_flash=use_flash, remat=remat,
+        num_experts=num_experts, moe_top_k=moe_top_k,
+        model_flags=model_flags)
     training_config = TrainingConfig(
         batch_size=batch_size,
         max_seq_len=seq_len,
@@ -368,6 +427,53 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
     analytic_flops_step = flops_per_token(model_config, seq_len) \
         * trainer.tokens_per_step
     goodput = ledger.record(final=True)
+    # Mesh auto-planner cross-check (ISSUE 11): score THIS mesh with the
+    # planner's analytic model — or reuse the full --mesh auto search
+    # record — and price the prediction against the measured step time.
+    # Failure-guarded like the comms model above.
+    measured_step_ms = elapsed / steps * 1e3
+    try:
+        from tpu_trainer.parallel import planner as planner_lib
+
+        calibrated_peak = None
+        if not on_tpu:
+            # CPU correctness mode: no roofline table entry exists for the
+            # host platform, so calibrate the compute roofline from this
+            # run's achieved model FLOP/s — plan_error_frac then prices
+            # the comms + pipeline-bubble residual instead of a made-up
+            # compute constant. On TPU the device tables stand and the
+            # prediction error is honest end to end.
+            calibrated_peak = (tok_per_sec
+                               * flops_per_token(model_config, seq_len)
+                               / n_chips)
+        scored = planner_lib.plan_single(
+            trainer.model_config, dict(mesh.shape), trainer.strategy,
+            global_rows=batch_size * trainer.dp_size,
+            max_seq_len=seq_len, grad_accum=accum,
+            device_kind=getattr(next(iter(mesh.devices.flat)),
+                                "device_kind", ""),
+            peak_flops=calibrated_peak, hbm_gb=hbm_gb,
+            opt_state_bytes=_OPT_STATE_BYTES.get(opt_state_dtype, 4),
+            carry_cast=carry_cast)
+        if plan_record is None:
+            plan_record = scored
+            plan_record["auto"] = False
+        else:
+            # --mesh auto handed us the full search record: keep its
+            # ranked list (the ranking is relative, so a wrong absolute
+            # roofline cancels) but gate on the re-scored prediction for
+            # the mesh that actually ran.
+            plan_record = dict(plan_record)
+            plan_record["predicted_step_ms"] = scored["predicted_step_ms"]
+        if calibrated_peak is not None:
+            plan_record["calibrated_peak_flops"] = round(calibrated_peak, 1)
+        plan_record["measured_step_ms"] = round(measured_step_ms, 3)
+        plan_record["plan_error_frac"] = round(
+            abs(plan_record["predicted_step_ms"] - measured_step_ms)
+            / measured_step_ms, 4)
+    except Exception as e:  # pragma: no cover - defensive
+        plan_record = None
+        print(f"bench: mesh_plan failed: {e}", file=sys.stderr)
     return {
         "model_size": model_size,
         "params": model_config.num_parameters(),
@@ -410,6 +516,10 @@ def run_bench(*, model_size, batch_size, seq_len, steps, accum, use_flash,
         "goodput": {k: round(v, 4) if isinstance(v, float) else v
                     for k, v in goodput.items() if k != "kind"},
         "comms_model": comms,
+        "measured_step_ms": round(measured_step_ms, 3),
+        "predicted_step_ms": (plan_record or {}).get("predicted_step_ms"),
+        "plan_error_frac": (plan_record or {}).get("plan_error_frac"),
+        "mesh_plan": plan_record,
     }
 
 
@@ -424,9 +534,10 @@ def write_run_jsonl(path: str, detail: dict) -> None:
     cum = 0.0
     steps = detail["steps"]
     tokens = detail["tokens_per_window"]
+    predicted_ms = detail.get("predicted_step_ms")
     for w, el in enumerate(detail.get("window_elapsed_s") or []):
         cum += el
-        records.append({
+        rec = {
             "kind": "train",
             "schema_version": SCHEMA_VERSION,
             "step": (w + 1) * steps,
@@ -435,7 +546,15 @@ def write_run_jsonl(path: str, detail: dict) -> None:
             "elapsed_s": round(cum, 3),
             "mfu": detail["mfu"],
             "peak_mem_gb": detail["peak_mem_gb"],
-        })
+        }
+        if predicted_ms is not None:
+            # Planner prediction vs THIS window's measured step time, so the
+            # analyzer's percentile machinery applies to the plan error too.
+            window_ms = el / steps * 1e3
+            rec["predicted_step_ms"] = predicted_ms
+            rec["plan_error_frac"] = round(
+                abs(predicted_ms - window_ms) / window_ms, 4)
+        records.append(rec)
     goodput = dict(detail["goodput"])
     goodput.update(kind="goodput", final=True, schema_version=SCHEMA_VERSION)
     records.append(goodput)
@@ -443,6 +562,8 @@ def write_run_jsonl(path: str, detail: dict) -> None:
         comms = dict(detail["comms_model"])
         comms.setdefault("schema_version", SCHEMA_VERSION)
         records.append(comms)
+    if detail.get("mesh_plan"):
+        records.append(dict(detail["mesh_plan"]))
     records.append({
         "kind": "cost_analysis",
         "schema_version": SCHEMA_VERSION,
@@ -632,19 +753,39 @@ def run_table(args):
     n = jax.device_count()
     rows = []
     base_per_method = {}
-    for method in ("DDP", "FSDP"):
+    methods = ("DDP", "FSDP") + (("AUTO",) if args.mesh == "auto" else ())
+    for method in methods:
         for chips in _chip_counts(n):
             if method == "FSDP" and chips == 1:
                 continue  # 1-chip FSDP is DDP
-            mesh_cfg = (MeshConfig(data=chips, fsdp=1) if method == "DDP"
-                        else MeshConfig(data=1, fsdp=chips))
-            strategy = "replicated" if method == "DDP" else "zero3"
+            if method == "AUTO" and chips != n:
+                continue  # the planner lane plans for the full pod
+            plan_record = None
+            batch_size = args.batch_size
+            if method == "AUTO":
+                # --table --mesh auto: one extra lane where the planner
+                # picks the split; the row's mesh_plan record carries its
+                # full ranking plus measured-vs-predicted step time.
+                from tpu_trainer.parallel import planner as planner_lib
+
+                plan_record = _auto_plan(args, n, default_strategy="zero3")
+                chosen = plan_record["chosen"]
+                mesh_cfg = planner_lib.mesh_config_for(chosen)
+                strategy = plan_record["strategy"]
+                batch_size = chosen["batch_per_shard"]
+            elif method == "DDP":
+                mesh_cfg = MeshConfig(data=chips, fsdp=1)
+                strategy = "replicated"
+            else:
+                mesh_cfg = MeshConfig(data=1, fsdp=chips)
+                strategy = "zero3"
             r = run_bench(
-                model_size=args.model_size, batch_size=args.batch_size,
+                model_size=args.model_size, batch_size=batch_size,
                 seq_len=args.seq_len, steps=args.steps, accum=args.accum,
                 use_flash=bool(args.flash), remat=_remat(args),
                 mesh_cfg=mesh_cfg, strategy=strategy,
-                devices=jax.devices()[:chips],
+                devices=jax.devices()[:chips], plan_record=plan_record,
+                hbm_gb=args.hbm_gb,
             )
             r["method"] = method
             base = base_per_method.setdefault(
@@ -663,8 +804,9 @@ def run_table(args):
 
 def format_table(rows) -> str:
     lines = [
-        "| Method | Chips | tok/s | tok/s/chip | Peak mem/chip | MFU | Scaling eff. |",
-        "|---|---|---|---|---|---|---|",
+        "| Method | Chips | tok/s | tok/s/chip | Peak mem/chip | MFU "
+        "| Scaling eff. | Plan err |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         mem = f"{r['peak_mem_gb']:.2f} GB" if r["peak_mem_gb"] else "n/a"
@@ -675,9 +817,16 @@ def format_table(rows) -> str:
         mfu_s = f"{100 * r['mfu']:.1f}%" if r["mfu"] else "n/a"
         eff = (f"{100 * r['scaling_efficiency']:.0f}%"
                if r.get("scaling_efficiency") else "—")
+        method = r["method"]
+        if method == "AUTO" and r.get("mesh"):
+            method += " (" + "x".join(
+                str(v) for v in r["mesh"].values()) + ")"
+        perr = r.get("plan_error_frac")
+        perr_s = f"{100 * perr:.0f}%" if perr is not None else "—"
         lines.append(
-            f"| {r['method']} | {r['n_chips']} | {r['tok_per_sec']:,.0f} "
-            f"| {r['tok_per_sec_per_chip']:,.0f} | {mem} | {mfu_s} | {eff} |"
+            f"| {method} | {r['n_chips']} | {r['tok_per_sec']:,.0f} "
+            f"| {r['tok_per_sec_per_chip']:,.0f} | {mem} | {mfu_s} | {eff} "
+            f"| {perr_s} |"
         )
     return "\n".join(lines)
 
@@ -724,6 +873,13 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+    # Partitionable threefry, same as tests/conftest.py: without it the
+    # pipeline stage shard_map lowers per-step RNG to a PartitionId
+    # instruction the SPMD partitioner rejects — stage>1 meshes (--mesh
+    # auto picks them freely) would crash at the first train step.
+    import jax as _jax
+
+    _jax.config.update("jax_threefry_partitionable", True)
     args = _build_parser().parse_args()
     # No LIBTPU_INIT_ARGS scoped-VMEM raise here anymore: the flash
     # backward now dispatches to the two-kernel split path past s=2048
@@ -747,14 +903,37 @@ def main() -> None:
 
     from tpu_trainer.parallel.mesh import MeshConfig
 
-    mesh_cfg = MeshConfig(
-        data=args.mesh_data if args.mesh_data is not None
-        else (-1 if args.mesh_fsdp is None else 1),
-        fsdp=args.mesh_fsdp if args.mesh_fsdp is not None else 1,
-        sequence=args.mesh_sequence,
-        tensor=args.mesh_tensor,
-        stage=args.mesh_stage,
-    )
+    plan_record = None
+    if args.mesh == "auto":
+        if (args.mesh_data is not None or args.mesh_fsdp is not None
+                or args.mesh_tensor != 1 or args.mesh_sequence != 1
+                or args.mesh_expert != 1 or args.mesh_stage != 1):
+            raise SystemExit(
+                "--mesh auto and explicit --mesh-* splits are mutually "
+                "exclusive — drop the --mesh-* flags to let the planner "
+                "choose, or pin the mesh and drop --mesh auto")
+        import jax
+
+        from tpu_trainer.parallel import planner as planner_lib
+
+        plan_record = _auto_plan(args, jax.device_count())
+        chosen = plan_record["chosen"]
+        mesh_cfg = planner_lib.mesh_config_for(chosen)
+        # The planner holds the GLOBAL batch fixed; run on the chosen
+        # split's per-shard slice of it.
+        args.batch_size = chosen["batch_per_shard"]
+        for line in planner_lib.render_table(plan_record):
+            print(f"bench: {line}", file=sys.stderr)
+    else:
+        mesh_cfg = MeshConfig(
+            data=args.mesh_data if args.mesh_data is not None
+            else (-1 if args.mesh_fsdp is None else 1),
+            fsdp=args.mesh_fsdp if args.mesh_fsdp is not None else 1,
+            sequence=args.mesh_sequence,
+            tensor=args.mesh_tensor,
+            expert=args.mesh_expert,
+            stage=args.mesh_stage,
+        )
     if args.packed:
         result = run_packed(args, mesh_cfg)
         print(json.dumps(result))
@@ -775,6 +954,7 @@ def main() -> None:
         checkpoint_every=args.checkpoint_every, stream=args.stream,
         prefetch_depth=args.prefetch_depth,
         device_prefetch_depth=args.device_prefetch_depth,
+        plan_record=plan_record, hbm_gb=args.hbm_gb,
     )
     comms = detail.get("comms_model") or {}
     result = {
@@ -801,6 +981,11 @@ def main() -> None:
             "total_bytes_per_device_per_step"),
         "comms_compute_ratio": comms.get("comms_compute_ratio"),
         "roofline_bound": comms.get("bound"),
+        # Mesh auto-planner validation loop (ISSUE 11): analytic predicted
+        # step time for THIS mesh vs the measured windows.
+        "measured_step_ms": detail["measured_step_ms"],
+        "predicted_step_ms": detail["predicted_step_ms"],
+        "plan_error_frac": detail["plan_error_frac"],
     }
     # Side-channel detail (stderr keeps stdout to the single JSON line the
     # driver parses).

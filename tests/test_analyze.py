@@ -436,3 +436,101 @@ data:
         assert report["records"], "ring should hold the emitted records"
         assert all("schema_version" in rec for rec in report["records"])
         assert report["snapshot"]["model_config"]["hidden_size"] == 32
+
+
+def _plan_record(*, auto=True, predicted=10.0, measured=None, err=None):
+    rec = {
+        "kind": "mesh_plan", "schema_version": SCHEMA_VERSION,
+        "devices": 8, "strategy": "zero3", "global_rows": 16,
+        "seq_len": 16, "grad_accum": 1, "device_kind": "cpu",
+        "hbm_budget_gb": None, "n_enumerated": 56, "n_feasible": 29,
+        "pruned": {"divisibility": 27, "hbm": 0}, "auto": auto,
+        "chosen": {"mesh": {"data": 1, "fsdp": 8, "sequence": 1,
+                            "tensor": 1, "expert": 1, "stage": 1},
+                   "batch_per_shard": 2, "predicted_step_ms": predicted,
+                   "compute_ms": 9.0, "comms_ms": 1.0, "bubble_factor": 1.0,
+                   "bytes_per_device": 1e6, "peak_hbm_gb": 0.5,
+                   "bound": "compute"},
+        "ranked": [], "predicted_step_ms": predicted,
+        "assumptions": {},
+    }
+    if measured is not None:
+        rec["measured_step_ms"] = measured
+        rec["plan_error_frac"] = (err if err is not None else
+                                  abs(predicted - measured) / measured)
+    return rec
+
+
+class TestPlanSection:
+    def test_summarize_and_render_plan(self, tmp_path):
+        recs = _run_records()
+        for r in recs:
+            if r["kind"] == "train":
+                r["plan_error_frac"] = 0.05
+        recs.append(_plan_record(measured=10.5))
+        report = analyze.summarize(analyze.load_records(
+            _write(tmp_path / "run.jsonl", recs)))
+        pl = report["plan"]
+        assert pl["auto"] is True
+        assert pl["mesh"] == {"data": 1, "fsdp": 8, "sequence": 1,
+                              "tensor": 1, "expert": 1, "stage": 1}
+        # Median of the per-window train errors wins over the record's own.
+        assert pl["plan_error_frac"] == pytest.approx(0.05)
+        assert pl["measured_step_ms"] == 10.5
+        text = "\n".join(analyze.render(report))
+        assert "auto mesh 1x8x1x1x1x1" in text
+        assert "median err 5.0%" in text
+
+    def test_plan_without_measurement_still_reports(self, tmp_path):
+        # Training-CLI --mesh auto runs log the plan but no measured step.
+        recs = _run_records() + [_plan_record()]
+        report = analyze.summarize(analyze.load_records(
+            _write(tmp_path / "run.jsonl", recs)))
+        assert report["plan"]["measured_step_ms"] is None
+        assert any("plan" in l for l in analyze.render(report))
+
+    def test_gate_passes_under_tol_and_fails_over(self, tmp_path):
+        base = _write(tmp_path / "b.jsonl",
+                      _run_records() + [_plan_record(measured=10.5)])
+        good = _write(tmp_path / "g.jsonl",
+                      _run_records() + [_plan_record(measured=11.0)])
+        assert analyze.main([good, "--compare", base]) == 0
+        bad = _write(tmp_path / "f.jsonl",
+                     _run_records() + [_plan_record(measured=20.0)])
+        assert analyze.main([bad, "--compare", base]) == 1
+
+    def test_gate_is_absolute_not_relative(self, tmp_path):
+        # Base run 45% off, new run 35% off: an IMPROVEMENT, but still over
+        # the fixed 30% budget — the absolute gate fails it anyway.
+        base = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "b.jsonl",
+            _run_records() + [_plan_record(predicted=14.5, measured=10.0)])))
+        new = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "n.jsonl",
+            _run_records() + [_plan_record(predicted=13.5, measured=10.0)])))
+        verdicts = {v["metric"]: v for v in analyze.compare(base, new)}
+        v = verdicts["plan_error_frac"]
+        assert v["verdict"] == "FAIL" and v["absolute"] is True
+        lines = analyze.render_verdicts([v])
+        assert any("tol 30% abs" in l for l in lines)
+
+    def test_gate_skips_without_measured_step(self, tmp_path):
+        # CLI-only runs (plan logged, nothing measured) and plan-less runs
+        # both SKIP rather than fail.
+        base = _write(tmp_path / "b.jsonl",
+                      _run_records() + [_plan_record(measured=10.5)])
+        unmeasured = _write(tmp_path / "u.jsonl",
+                            _run_records() + [_plan_record()])
+        assert analyze.main([unmeasured, "--compare", base]) == 0
+        planless = _write(tmp_path / "p.jsonl", _run_records())
+        assert analyze.main([planless, "--compare", base]) == 0
+
+    def test_plan_tol_flag_reaches_gate(self, tmp_path):
+        base = _write(tmp_path / "b.jsonl",
+                      _run_records() + [_plan_record(measured=10.5)])
+        new = _write(tmp_path / "n.jsonl",
+                     _run_records() + [_plan_record(measured=11.0)])
+        # ~9% error: passes the default 30% budget, fails a 5% one.
+        assert analyze.main([new, "--compare", base]) == 0
+        assert analyze.main([new, "--compare", base,
+                             "--plan-tol", "0.05"]) == 1

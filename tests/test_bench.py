@@ -12,6 +12,7 @@ import jax
 import pytest
 
 import bench
+from tpu_trainer.utils.logging import SCHEMA_VERSION
 
 
 class TestHarnessLogic:
@@ -125,3 +126,74 @@ class TestPackedLane:
         for lane in ("packed", "padded"):
             assert r[lane]["tok_per_sec"] > 0
             assert 0.0 < r[lane]["non_pad_frac"] <= 1.0
+
+
+class TestMeshPlanLane:
+    """--mesh auto + the mesh_plan validation loop (ISSUE 11)."""
+
+    def _args(self, *extra):
+        return bench._build_parser().parse_args([
+            "--model-size", "tiny", "--batch-size", "1", "--seq-len", "32",
+            "--steps", "1", "--flash", "0", "--remat", "0",
+        ] + list(extra))
+
+    def test_format_table_plan_column(self):
+        rows = [{"method": "AUTO", "n_chips": 8, "tok_per_sec": 100.0,
+                 "tok_per_sec_per_chip": 12.5, "peak_mem_gb": None,
+                 "mfu": None, "scaling_efficiency": None,
+                 "mesh": {"data": 4, "fsdp": 1, "sequence": 1, "tensor": 2,
+                          "expert": 1, "stage": 1},
+                 "plan_error_frac": 0.12}]
+        md = bench.format_table(rows)
+        assert md.splitlines()[0].endswith("| Plan err |")
+        assert "| AUTO (4x1x1x2x1x1) | 8 |" in md
+        assert "| 12% |" in md
+
+    def test_auto_plan_record_and_cpu_stage_exclusion(self):
+        rec = bench._auto_plan(self._args("--mesh", "auto"),
+                               jax.device_count())
+        assert rec["kind"] == "mesh_plan"
+        assert rec["auto"] is True
+        assert rec["chosen"] == rec["ranked"][0]
+        # The CPU SPMD partitioner can't lower the GPipe stage shard_map,
+        # so correctness-mode planning must never hand back a stage mesh.
+        assert rec["pruned"].get("excluded", 0) >= 1
+        assert all(e["mesh"]["stage"] == 1 for e in rec["ranked"])
+
+    def test_auto_conflicts_with_explicit_mesh(self, monkeypatch):
+        import sys as _sys
+
+        monkeypatch.setattr(_sys, "argv", [
+            "bench.py", "--model-size", "tiny", "--mesh", "auto",
+            "--mesh-tensor", "2"])
+        with pytest.raises(SystemExit, match="mutually exclusive"):
+            bench.main()
+
+    def test_table_mesh_auto_end_to_end(self, monkeypatch, tmp_path):
+        # Full-pod lanes only: the AUTO lane plans for the whole pod anyway,
+        # and one pinned lane is enough to cover the plan_single path.
+        monkeypatch.setattr(bench, "_chip_counts", lambda n: [n])
+        args = self._args("--mesh", "auto")
+        rows = bench.run_table(args)
+        assert [r["method"] for r in rows] == ["DDP", "FSDP", "AUTO"]
+        auto = rows[-1]
+        rec = auto["mesh_plan"]
+        assert rec["kind"] == "mesh_plan"
+        assert rec["schema_version"] == SCHEMA_VERSION
+        assert rec["auto"] is True
+        # Self-consistency: the mesh the lane ran is the search argmin.
+        assert rec["chosen"] == rec["ranked"][0]
+        assert rec["chosen"]["predicted_step_ms"] == min(
+            e["predicted_step_ms"] for e in rec["ranked"])
+        assert auto["mesh"] == rec["chosen"]["mesh"]
+        # Validation-loop fields: measured vs (calibrated) predicted.
+        assert rec["measured_step_ms"] > 0
+        assert auto["plan_error_frac"] == pytest.approx(
+            abs(rec["predicted_step_ms"] - rec["measured_step_ms"])
+            / rec["measured_step_ms"], abs=1e-3)
+        # Pinned lanes carry the plan_single record (auto: False) so the
+        # analyzer can gate prediction error on DP/zero3 runs too.
+        for pinned in rows[:2]:
+            assert pinned["mesh_plan"]["auto"] is False
+            assert pinned["plan_error_frac"] is not None
+        json.dumps(rows)

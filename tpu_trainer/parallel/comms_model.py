@@ -114,11 +114,11 @@ def _spec_axes(spec) -> tuple:
     return tuple(axes)
 
 
-def _shard_factor(spec, mesh, exclude=()) -> int:
+def _shard_factor(spec, axis_sizes, exclude=()) -> int:
     f = 1
     for ax in _spec_axes(spec):
         if ax not in exclude:
-            f *= mesh.shape[ax]
+            f *= axis_sizes[ax]
     return f
 
 
@@ -130,54 +130,74 @@ def _ici_bytes_per_sec(device_kind: str) -> float:
     return _DEFAULT_ICI
 
 
-def build(trainer) -> dict:
-    """Analytic per-device bytes/step for every mesh axis of ``trainer``.
+def build_core(
+    param_shapes,
+    axis_sizes,
+    strategy: str,
+    *,
+    model_config,
+    batch_size: int,
+    max_seq_len: int,
+    grad_accum: int,
+    device_kind: str = "",
+    peak_flops: Optional[float] = None,
+) -> dict:
+    """Trainer-independent core of the comms model.
 
-    Pure shape arithmetic — evaluates no step, compiles nothing (parameter
-    shapes come from ``jax.eval_shape`` on ``model.init``). Returns the
-    ``kind:"comms_model"`` record; the caller stamps ``step`` and logs it.
+    Everything the model needs is shape arithmetic over an abstract param
+    tree plus the run's dimensions — no live ``Trainer`` or ``Mesh``:
+
+    - ``param_shapes``: abstract parameter tree (``jax.eval_shape`` output);
+    - ``axis_sizes``: ``{axis_name: size}`` for the six mesh axes (missing
+      axes default to 1) — ``mesh.shape`` or a planner candidate;
+    - ``strategy``: sharding strategy (aliases accepted);
+    - ``batch_size``: per-data-shard rows per micro-batch;
+    - ``device_kind`` / ``peak_flops``: roofline hardware constants.
+      ``peak_flops=None`` keeps the live-trainer behavior (local device
+      lookup); the offline planner passes an explicit figure so plans for a
+      different device kind don't inherit this process's hardware.
+
+    This is what the mesh auto-planner (``parallel/planner.py``) scores
+    candidate meshes with; :func:`build` is the thin trainer wrapper and its
+    output is byte-for-byte what it always was.
     """
-    mesh = trainer.mesh
-    mc = trainer.model_config
-    tc = trainer.training_config
-    d = mesh.shape[mesh_lib.DATA_AXIS]
-    f = mesh.shape[mesh_lib.FSDP_AXIS]
-    sp = mesh.shape[mesh_lib.SEQUENCE_AXIS]
-    tp = mesh.shape[mesh_lib.TENSOR_AXIS]
-    ep = mesh.shape.get(mesh_lib.EXPERT_AXIS, 1)
-    st = mesh.shape.get(mesh_lib.STAGE_AXIS, 1)
-    accum = tc.gradient_accumulation_steps
-    rows = tc.batch_size                      # per-data-shard rows per micro
-    seq_local = tc.max_seq_len // sp
+    strategy = shard_lib.canonical_strategy(strategy)
+    mc = model_config
+    d = axis_sizes.get(mesh_lib.DATA_AXIS, 1)
+    f = axis_sizes.get(mesh_lib.FSDP_AXIS, 1)
+    sp = axis_sizes.get(mesh_lib.SEQUENCE_AXIS, 1)
+    tp = axis_sizes.get(mesh_lib.TENSOR_AXIS, 1)
+    ep = axis_sizes.get(mesh_lib.EXPERT_AXIS, 1)
+    st = axis_sizes.get(mesh_lib.STAGE_AXIS, 1)
+    sizes = {ax: axis_sizes.get(ax, 1) for ax in mesh_lib.MESH_AXES}
+    n_devices = d * f * sp * tp * ep * st
+    accum = grad_accum
+    rows = batch_size                         # per-data-shard rows per micro
+    seq_local = max_seq_len // sp
     act_bytes = jnp.dtype(mc.compute_dtype).itemsize
     hidden = mc.hidden_size
     layers = mc.num_layers
 
-    param_shapes = jax.eval_shape(
-        lambda rng: trainer.model.init(
-            rng, jnp.zeros((1, 8), jnp.int32))["params"],
-        jax.random.PRNGKey(0),
-    )
-    p_specs = shard_lib.params_specs(param_shapes, mesh, trainer.strategy)
-    g_specs = shard_lib.grads_specs(param_shapes, mesh, trainer.strategy)
+    p_specs = shard_lib.params_specs_from_sizes(param_shapes, sizes, strategy)
+    g_specs = shard_lib.grads_specs_from_sizes(param_shapes, sizes, strategy)
     params_total = int(sum(
         int(np.prod(x.shape)) if x.shape else 1
         for x in jax.tree_util.tree_leaves(param_shapes)))
 
     # Param-tree traffic: DP grad all-reduce + FSDP gathers/scatters.
     acc = {"data": 0.0, "fsdp_gather": 0.0, "fsdp_scatter": 0.0}
-    zero2_regather = trainer.strategy == "zero2"
+    zero2_regather = strategy == "zero2"
 
     def per_leaf(leaf, pspec, gspec):
         size = int(np.prod(leaf.shape)) if leaf.shape else 1
         # data axis: all-reduce of the per-device f32 grad shard (for
         # ZeRO meshes this runs on the post-reduce-scatter shard).
-        gshard = size * GRAD_BYTES / _shard_factor(gspec, mesh)
+        gshard = size * GRAD_BYTES / _shard_factor(gspec, sizes)
         acc["data"] += ring_all_reduce_bytes(gshard, d)
         if f > 1 and mesh_lib.FSDP_AXIS in _spec_axes(gspec):
             # fsdp grad reduce-scatter, on the pre-scatter f32 payload.
             pre = size * GRAD_BYTES / _shard_factor(
-                gspec, mesh, exclude=(mesh_lib.FSDP_AXIS,))
+                gspec, sizes, exclude=(mesh_lib.FSDP_AXIS,))
             acc["fsdp_scatter"] += ring_reduce_scatter_bytes(pre, f)
             if zero2_regather and mesh_lib.FSDP_AXIS not in _spec_axes(pspec):
                 # zero2: params stay replicated, so the fsdp-sharded
@@ -189,7 +209,7 @@ def build(trainer) -> dict:
             # once for the backward re-gather (no full-tree liveness).
             itemsize = act_bytes if len(leaf.shape) >= 2 else 4
             pre = size * itemsize / _shard_factor(
-                pspec, mesh, exclude=(mesh_lib.FSDP_AXIS,))
+                pspec, sizes, exclude=(mesh_lib.FSDP_AXIS,))
             acc["fsdp_gather"] += 2.0 * ring_all_gather_bytes(pre, f)
 
     jax.tree_util.tree_map(per_leaf, param_shapes, p_specs, g_specs)
@@ -262,20 +282,19 @@ def build(trainer) -> dict:
     total = sum(v["bytes"] for v in per_axis.values())
 
     # Roofline: serial (no-overlap) comms time vs analytic compute time.
-    device = next(iter(mesh.devices.flat))
-    peak = device_peak_flops()
-    ici = _ici_bytes_per_sec(getattr(device, "device_kind", ""))
-    flops_step = flops_per_token(mc, seq_len=tc.max_seq_len) * (
-        trainer.tokens_per_step)
-    per_device_flops = flops_step / mesh.size
+    peak = peak_flops if peak_flops is not None else device_peak_flops()
+    ici = _ici_bytes_per_sec(device_kind)
+    tokens_per_step = rows * accum * d * f * max_seq_len
+    flops_step = flops_per_token(mc, seq_len=max_seq_len) * tokens_per_step
+    per_device_flops = flops_step / n_devices
     compute_s = per_device_flops / peak
     comms_s = total / ici
     ratio = comms_s / compute_s if compute_s > 0 else float("inf")
 
     return {
         "kind": "comms_model",
-        "mesh": dict(mesh.shape),
-        "strategy": trainer.strategy,
+        "mesh": sizes,
+        "strategy": strategy,
         "params": params_total,
         "per_axis": per_axis,
         "total_bytes_per_device_per_step": total,
@@ -291,9 +310,59 @@ def build(trainer) -> dict:
             "tp_head_excluded": "vocab-sharded fused head reduces scalars",
             "peak_flops_per_device": peak,
             "ici_bytes_per_sec": ici,
-            "device_kind": getattr(device, "device_kind", "unknown"),
+            "device_kind": device_kind or "unknown",
         },
     }
+
+
+def abstract_params(model_config):
+    """Abstract parameter tree for a model config (no weights allocated).
+
+    Exactly the tree :func:`build` derives from a live trainer — valid for
+    planning because nothing mesh-dependent changes the parameter *shapes*
+    (TP/FSDP change PartitionSpecs only, and the fused-projections toggle
+    the Trainer flips under TP keeps the tree identical: fusion is disabled
+    whenever ``tensor > 1``, and the planner follows the same rule via the
+    model config it is handed).
+    """
+    from tpu_trainer.models.gpt import GPT
+
+    model = GPT(model_config)
+    return jax.eval_shape(
+        lambda rng: model.init(rng, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+
+
+def build(trainer) -> dict:
+    """Analytic per-device bytes/step for every mesh axis of ``trainer``.
+
+    Pure shape arithmetic — evaluates no step, compiles nothing (parameter
+    shapes come from ``jax.eval_shape`` on ``model.init``). Returns the
+    ``kind:"comms_model"`` record; the caller stamps ``step`` and logs it.
+
+    Thin wrapper over :func:`build_core` (same output, byte for byte): it
+    only extracts the trainer's abstract param tree, mesh axis sizes, and
+    run dimensions.
+    """
+    mesh = trainer.mesh
+    tc = trainer.training_config
+    param_shapes = jax.eval_shape(
+        lambda rng: trainer.model.init(
+            rng, jnp.zeros((1, 8), jnp.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+    device = next(iter(mesh.devices.flat))
+    return build_core(
+        param_shapes,
+        dict(mesh.shape),
+        trainer.strategy,
+        model_config=trainer.model_config,
+        batch_size=tc.batch_size,
+        max_seq_len=tc.max_seq_len,
+        grad_accum=tc.gradient_accumulation_steps,
+        device_kind=getattr(device, "device_kind", ""),
+    )
 
 
 def summary_lines(record: dict) -> List[str]:

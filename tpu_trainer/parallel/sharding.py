@@ -172,11 +172,18 @@ def _leaf_spec(path_keys, shape, *, fsdp_size: int, tensor_size: int,
     return P(*dims)
 
 
-def _specs_for_tree(tree: Any, mesh: Mesh, *, shard_fsdp: bool) -> Any:
-    fsdp_size = mesh.shape[FSDP_AXIS]
-    tensor_size = mesh.shape[TENSOR_AXIS]
-    expert_size = mesh.shape.get(EXPERT_AXIS, 1)
-    stage_size = mesh.shape.get(STAGE_AXIS, 1)
+def _specs_for_sizes(tree: Any, axis_sizes, *, shard_fsdp: bool) -> Any:
+    """Spec tree from axis sizes alone (a ``{axis_name: size}`` mapping).
+
+    The placement rules are pure shape/path arithmetic — no live ``Mesh``
+    required — which is what lets the mesh auto-planner score candidate
+    meshes that were never materialized. ``mesh.shape`` is such a mapping,
+    so the Mesh entry points below just delegate here.
+    """
+    fsdp_size = axis_sizes.get(FSDP_AXIS, 1)
+    tensor_size = axis_sizes.get(TENSOR_AXIS, 1)
+    expert_size = axis_sizes.get(EXPERT_AXIS, 1)
+    stage_size = axis_sizes.get(STAGE_AXIS, 1)
     return jax.tree_util.tree_map_with_path(
         lambda path, x: _leaf_spec(
             _path_keys(path), getattr(x, "shape", ()),
@@ -188,14 +195,31 @@ def _specs_for_tree(tree: Any, mesh: Mesh, *, shard_fsdp: bool) -> Any:
     )
 
 
+def _specs_for_tree(tree: Any, mesh: Mesh, *, shard_fsdp: bool) -> Any:
+    return _specs_for_sizes(tree, mesh.shape, shard_fsdp=shard_fsdp)
+
+
+def params_specs_from_sizes(params: Any, axis_sizes, strategy: str) -> Any:
+    """``params_specs`` from a ``{axis: size}`` mapping instead of a Mesh."""
+    strategy = canonical_strategy(strategy)
+    return _specs_for_sizes(params, axis_sizes, shard_fsdp=strategy == "zero3")
+
+
 def params_specs(params: Any, mesh: Mesh, strategy: str) -> Any:
     """PartitionSpec tree for model parameters under a strategy.
 
     TP placement applies in every strategy (a TP-sharded param is never
     replicated over ``tensor``); the fsdp axis applies only under zero3.
     """
+    return params_specs_from_sizes(params, mesh.shape, strategy)
+
+
+def opt_state_specs_from_sizes(opt_state: Any, axis_sizes, strategy: str) -> Any:
+    """``opt_state_specs`` from a ``{axis: size}`` mapping instead of a Mesh."""
     strategy = canonical_strategy(strategy)
-    return _specs_for_tree(params, mesh, shard_fsdp=strategy == "zero3")
+    return _specs_for_sizes(
+        opt_state, axis_sizes, shard_fsdp=strategy in ("zero2", "zero3")
+    )
 
 
 def opt_state_specs(opt_state: Any, mesh: Mesh, strategy: str) -> Any:
@@ -206,9 +230,14 @@ def opt_state_specs(opt_state: Any, mesh: Mesh, strategy: str) -> Any:
     suffix-matching the param path still applies the TP rules correctly.
     ``opt_state`` may be a tree of arrays or of ShapeDtypeStructs.
     """
+    return opt_state_specs_from_sizes(opt_state, mesh.shape, strategy)
+
+
+def grads_specs_from_sizes(params: Any, axis_sizes, strategy: str) -> Any:
+    """``grads_specs`` from a ``{axis: size}`` mapping instead of a Mesh."""
     strategy = canonical_strategy(strategy)
-    return _specs_for_tree(
-        opt_state, mesh, shard_fsdp=strategy in ("zero2", "zero3")
+    return _specs_for_sizes(
+        params, axis_sizes, shard_fsdp=strategy in ("zero2", "zero3")
     )
 
 
@@ -218,10 +247,7 @@ def grads_specs(params: Any, mesh: Mesh, strategy: str) -> Any:
     Gradients of TP-sharded params carry the same tensor dims in every
     strategy; the fsdp axis applies under zero2/zero3.
     """
-    strategy = canonical_strategy(strategy)
-    return _specs_for_tree(
-        params, mesh, shard_fsdp=strategy in ("zero2", "zero3")
-    )
+    return grads_specs_from_sizes(params, mesh.shape, strategy)
 
 
 def to_shardings(spec_tree: Any, mesh: Mesh) -> Any:

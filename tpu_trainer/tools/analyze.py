@@ -198,6 +198,35 @@ def summarize(records: List[dict]) -> dict:
             "hlo_mismatches": c.get("hlo_mismatches"),
         }
 
+    # Mesh auto-planner validation loop (parallel/planner.py): the
+    # mesh_plan record carries the chosen split and its predicted step
+    # time; bench train records carry a per-window plan_error_frac, whose
+    # MEDIAN is the number the --plan-tol gate prices. A run with train
+    # windows but no mesh_plan record (training CLI --mesh auto runs log
+    # the plan but never a measured step-ms) still reports the plan.
+    plans = by_kind.get("mesh_plan", [])
+    plan_errors = [r.get("plan_error_frac") for r in train
+                   if r.get("plan_error_frac") is not None]
+    if plans:
+        p = plans[-1]
+        chosen = p.get("chosen") or {}
+        report["plan"] = {
+            "auto": p.get("auto"),
+            "mesh": chosen.get("mesh"),
+            "strategy": p.get("strategy"),
+            "batch_per_shard": chosen.get("batch_per_shard"),
+            "n_enumerated": p.get("n_enumerated"),
+            "n_feasible": p.get("n_feasible"),
+            "pruned": p.get("pruned"),
+            "predicted_step_ms": p.get("predicted_step_ms"),
+            "measured_step_ms": p.get("measured_step_ms"),
+            "plan_error_frac": (_percentile(plan_errors, 50)
+                                if plan_errors
+                                else p.get("plan_error_frac")),
+            "bound": chosen.get("bound"),
+            "predicted_peak_hbm_gb": chosen.get("peak_hbm_gb"),
+        }
+
     cost = by_kind.get("cost_analysis", [])
     if cost:
         report["cost"] = {k: cost[-1].get(k) for k in (
@@ -372,6 +401,23 @@ def render(report: dict) -> List[str]:
             f" -> {c.get('bound')}-bound")
         for m in c.get("hlo_mismatches") or []:
             lines.append(f"comms   HLO mismatch: {m}")
+    pl = report.get("plan")
+    if pl:
+        mesh_s = ("x".join(str(v) for v in (pl.get("mesh") or {}).values())
+                  or "?")
+        err = pl.get("plan_error_frac")
+        lines.append(
+            f"plan    {'auto ' if pl.get('auto') else ''}mesh {mesh_s}"
+            f" ({pl.get('strategy')}, batch/shard"
+            f" {pl.get('batch_per_shard')})"
+            + (f" | {pl['n_feasible']}/{pl['n_enumerated']} feasible"
+               if pl.get("n_enumerated") else "")
+            + f" | predicted {_fmt(pl.get('predicted_step_ms'))}ms"
+            + (f" measured {_fmt(pl.get('measured_step_ms'))}ms"
+               if pl.get("measured_step_ms") is not None else "")
+            + (f" | median err {_fmt(err * 100, 1)}%"
+               if err is not None else "")
+            + (f" -> {pl.get('bound')}-bound" if pl.get("bound") else ""))
     r = report.get("recompiles")
     if r:
         flag = "  ** RECOMPILE STORM (loader shape churn?) **" if r["storm"] else ""
@@ -446,7 +492,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             serve_lat_tol: float = 0.25,
             recovery_tol: float = 120.0,
             grow_tol: float = 120.0,
-            pack_tol: float = 0.05) -> List[dict]:
+            pack_tol: float = 0.05,
+            plan_tol: float = 0.30) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -488,6 +535,14 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
     0.98 -> 0.93 drop and a 0.40 -> 0.38 drop are both ~5% relative but
     only the first burns five points of paid-for compute. SKIP when either
     run doesn't track packing.
+
+    ``plan_error_frac`` is ABSOLUTE against a fixed budget, like the
+    elastic gates: the mesh auto-planner's median predicted-vs-measured
+    step-time error (parallel/planner.py, bench.py's per-window
+    ``plan_error_frac``) must stay under ``plan_tol`` regardless of the
+    baseline — a cost model that's 50% off misranks meshes whether or not
+    it was 50% off last week. SKIP when the run carries no mesh_plan
+    record with a measured step time.
     """
     def get(report, *keys):
         cur = report
@@ -581,6 +636,26 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "absolute": True,
         })
 
+    # Planner prediction-quality gate: only a run that actually measured
+    # (bench) carries measured_step_ms; a training CLI --mesh auto run
+    # logs the plan without one and SKIPs.
+    new_plan_err = (get(new, "plan", "plan_error_frac")
+                    if get(new, "plan", "measured_step_ms") is not None
+                    else None)
+    if new_plan_err is None:
+        verdicts.append({"metric": "plan_error_frac", "verdict": "SKIP",
+                         "base": get(base, "plan", "plan_error_frac"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "plan_error_frac",
+            "verdict": "FAIL" if new_plan_err >= plan_tol - eps else "PASS",
+            "base": get(base, "plan", "plan_error_frac"),
+            "new": round(new_plan_err, 4),
+            "tolerance_frac": plan_tol,
+            "absolute": True,
+        })
+
     new_rec_max = get(new, "elastic", "recovery_seconds_max")
     if new_rec_max is None:
         verdicts.append({"metric": "recovery_seconds_max", "verdict": "SKIP",
@@ -660,8 +735,12 @@ def render_verdicts(verdicts: List[dict]) -> List[str]:
                 f" new {_fmt(v['new'], 4)} ({v['delta_pct']:+.1f}%{kind},"
                 f" tol {v['tolerance_pct']:.0f}%{kind})")
         else:
-            tol = (f", tol {_fmt(v['tolerance_s'], 0)}s abs"
-                   if v.get("tolerance_s") is not None else "")
+            if v.get("tolerance_s") is not None:
+                tol = f", tol {_fmt(v['tolerance_s'], 0)}s abs"
+            elif v.get("tolerance_frac") is not None:
+                tol = f", tol {_fmt(v['tolerance_frac'] * 100, 0)}% abs"
+            else:
+                tol = ""
             lines.append(
                 f"{v['verdict']} {v['metric']:<16} base {_fmt(v['base'], 2)}"
                 f" new {_fmt(v['new'], 2)} (absolute{tol})")
@@ -703,6 +782,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "any single world re-expansion (grant "
                              "detected -> first grown-world heartbeat) "
                              "took >= this many seconds (default 120)")
+    parser.add_argument("--plan-tol", type=float, default=0.30,
+                        help="ABSOLUTE gate on the mesh auto-planner: FAIL "
+                             "if the new run's median predicted-vs-measured "
+                             "step-time error is >= this fraction (default "
+                             "0.30); SKIP when the run carries no mesh_plan "
+                             "record with a measured step time")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -726,7 +811,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             overhead_tol=args.overhead_tol,
             serve_lat_tol=args.serve_lat_tol,
             recovery_tol=args.recovery_tol, grow_tol=args.grow_tol,
-            pack_tol=args.pack_tol)
+            pack_tol=args.pack_tol, plan_tol=args.plan_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

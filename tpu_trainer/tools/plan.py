@@ -1,0 +1,108 @@
+"""Standalone mesh auto-planner CLI.
+
+Prints the ranked plan table for a model preset and device count without
+touching a trainer — pure shape arithmetic, so planning for a v5e-256 pod
+from a laptop is instant:
+
+    python -m tpu_trainer.tools.plan --model small --devices 8
+    python -m tpu_trainer.tools.plan --model large --devices 256 \
+        --device-kind v5e --hbm_gb 16 --strategy zero3
+
+``--json`` emits the full ``kind:"mesh_plan"`` record (the same record a
+``--mesh auto`` training run logs to JSONL) for scripting.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.parallel import planner
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_trainer.tools.plan",
+        description="Rank feasible data x fsdp x sequence x tensor x expert "
+                    "x stage meshes for a model/pod from the analytic comms "
+                    "+ roofline model.")
+    p.add_argument("--model", default="small",
+                   help="GPTConfig preset (small/medium/large/xl) or 'tiny'")
+    p.add_argument("--devices", type=int, default=None,
+                   help="device count to plan for (default: this process's "
+                        "jax.device_count())")
+    p.add_argument("--batch-size", type=int, default=8,
+                   help="per-data-shard rows per micro-batch used to derive "
+                        "the fixed global batch (default 8)")
+    p.add_argument("--global-batch", type=int, default=None,
+                   help="global rows per micro-batch held fixed across "
+                        "candidates (default: batch-size * devices)")
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="training sequence length (default: the model's "
+                        "max_seq_len)")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient accumulation steps (default 1)")
+    p.add_argument("--strategy", default="zero3",
+                   help="sharding strategy to plan under (default zero3)")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="make the FFNs MoE with this many experts (opens "
+                        "the expert axis)")
+    p.add_argument("--hbm_gb", "--hbm-gb", dest="hbm_gb", type=float,
+                   default=None,
+                   help="per-device HBM budget in GiB (default: local "
+                        "device's bytes_limit; none on CPU)")
+    p.add_argument("--device-kind", default="",
+                   help="plan for this device kind's ICI/FLOPs tables "
+                        "(e.g. v5e, v5p) instead of the local device")
+    p.add_argument("--top-k", type=int, default=10,
+                   help="rows in the ranked table (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full mesh_plan record as JSON")
+    return p
+
+
+def _model_config(args) -> GPTConfig:
+    extra = {}
+    if args.seq_len:
+        extra["max_seq_len"] = args.seq_len
+    if args.num_experts:
+        extra["num_experts"] = args.num_experts
+        extra["moe_top_k"] = min(2, args.num_experts)
+    if args.model == "tiny":
+        return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, **extra)
+    return GPTConfig.preset(args.model, **extra)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    devices = args.devices
+    if devices is None:
+        import jax
+
+        devices = jax.device_count()
+    model_config = _model_config(args)
+    seq_len = args.seq_len or model_config.max_seq_len
+    global_rows = args.global_batch or args.batch_size * devices
+    try:
+        record = planner.plan(
+            model_config, devices,
+            global_rows=global_rows, max_seq_len=seq_len,
+            grad_accum=args.accum, strategy=args.strategy,
+            device_kind=args.device_kind, hbm_gb=args.hbm_gb,
+            top_k=args.top_k)
+    except planner.NoFeasiblePlanError as e:
+        print(f"plan: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(record))
+    else:
+        print("\n".join(planner.render_table(record)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

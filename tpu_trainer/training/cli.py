@@ -40,9 +40,10 @@ from tpu_trainer.data.device_prefetch import DevicePrefetcher
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.parallel import comms_model as comms_lib
 from tpu_trainer.parallel import mesh as mesh_lib
+from tpu_trainer.parallel import planner as planner_lib
 from tpu_trainer.training.config import TrainingConfig
 from tpu_trainer.training.trainer import (
-    ParallelConfig, RecompileWatchdog, Trainer,
+    _MP_TO_DTYPE, ParallelConfig, RecompileWatchdog, Trainer,
 )
 from tpu_trainer.utils import checkpoint as ckpt_lib
 from tpu_trainer.utils import faults, guards, profiling
@@ -275,6 +276,18 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                         "first batch, report the first layer/site with a "
                         "non-finite value, and exit without training")
     # mesh / multi-host
+    p.add_argument("--mesh", type=str, default=None, choices=["auto"],
+                   help="'auto' runs the mesh auto-planner at startup "
+                        "(parallel/planner.py): enumerate every feasible "
+                        "data x fsdp x sequence x tensor x expert x stage "
+                        "split, score with the analytic comms + roofline "
+                        "model, log a kind:\"mesh_plan\" record, and train "
+                        "on the winner. Mutually exclusive with explicit "
+                        "--mesh_* flags.")
+    p.add_argument("--hbm_gb", type=float, default=None,
+                   help="per-device HBM budget in GiB for --mesh auto "
+                        "pruning (default: the device's reported limit; "
+                        "no pruning on CPU)")
     p.add_argument("--mesh_data", type=int, default=None)
     p.add_argument("--mesh_fsdp", type=int, default=None)
     p.add_argument("--mesh_sequence", type=int, default=None,
@@ -492,11 +505,25 @@ def resolve_configs(args, mode: str):
     else:
         strategy = "replicated"
         default_mesh = mesh_lib.MeshConfig(data=-1, fsdp=1)
-    if strategy == "HYBRID_SHARD" and args.mesh_data is None and args.mesh_fsdp is None:
+    mesh_auto = args.mesh == "auto"
+    explicit_mesh = [
+        flag for flag in ("mesh_data", "mesh_fsdp", "mesh_sequence",
+                          "mesh_tensor", "mesh_expert", "mesh_stage")
+        if getattr(args, flag) is not None
+    ]
+    if mesh_auto and explicit_mesh:
+        raise SystemExit(
+            "--mesh auto and explicit --" + "/--".join(explicit_mesh) +
+            " are mutually exclusive: the planner picks every axis. Drop "
+            "the explicit split, or drop --mesh auto to pin it yourself."
+        )
+    if strategy == "HYBRID_SHARD" and not mesh_auto \
+            and args.mesh_data is None and args.mesh_fsdp is None:
         raise SystemExit(
             "HYBRID_SHARD needs an explicit mesh split: pass --mesh_data and "
-            "--mesh_fsdp (data replicas x fsdp shards). (In the reference this "
-            "mode is documented but unselectable — SURVEY.md §2.)"
+            "--mesh_fsdp (data replicas x fsdp shards), or --mesh auto. (In "
+            "the reference this mode is documented but unselectable — "
+            "SURVEY.md §2.)"
         )
     mesh_config = mesh_lib.MeshConfig(
         data=_pick(args.mesh_data, default_mesh.data),
@@ -576,6 +603,9 @@ def resolve_configs(args, mode: str):
         "comms_model": not bool(_pick(args.no_comms_model, False)),
         "flight_recorder_steps": _picki(args.flight_recorder_steps,
                                         None, 256),
+        # Mesh auto-planner (--mesh auto; parallel/planner.py).
+        "mesh_auto": mesh_auto,
+        "hbm_gb": args.hbm_gb,
     }
     return model_config, training_config, parallel_config, data_opts
 
@@ -890,11 +920,78 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         # would override an embedding harness's explicit jax.config choice
         # (e.g. the test suite's forced 8-device CPU backend).
         jax.config.update("jax_platforms", args.device)
+    # Partitionable threefry, same as tests/conftest.py: without it the
+    # pipeline stage shard_map lowers per-step RNG to a PartitionId
+    # instruction the SPMD partitioner rejects — stage>1 meshes (the
+    # planner picks them freely) would crash at the first train step.
+    jax.config.update("jax_threefry_partitionable", True)
     mesh_lib.initialize_distributed(auto=args.multihost)
 
     model_config, training_config, parallel_config, data_opts = resolve_configs(
         args, mode
     )
+
+    # --- mesh auto-planner / early mesh validation ---------------------
+    # Both paths share planner_lib.feasibility_error, so a split the CLI
+    # accepts here is exactly one the Trainer's own divisibility checks
+    # accept below — the predicate can't disagree with the pruning.
+    plan_record = None
+    n_devices = jax.device_count()
+    plan_mc = dataclasses.replace(
+        model_config, dtype=_MP_TO_DTYPE[training_config.mixed_precision])
+    plan_opt_bytes = {"float32": 4, "bfloat16": 2, "int8": 1}.get(
+        training_config.optimizer_state_dtype, 4)
+    if data_opts["mesh_auto"]:
+        # Hold the global batch a pure-DP run would have (per-shard
+        # batch_size on every device) fixed across candidates; the winner's
+        # per-shard batch is global_rows / its data*fsdp world.
+        global_rows = training_config.batch_size * n_devices
+        # The CPU SPMD partitioner cannot lower the GPipe stage shard_map
+        # (PartitionId rejection), so correctness-mode planning must not
+        # hand back a mesh the Trainer then crashes on. Real TPUs plan
+        # all six axes.
+        exclude = (() if jax.devices()[0].platform == "tpu"
+                   else ("stage",))
+        try:
+            plan_record = planner_lib.plan(
+                plan_mc, n_devices,
+                global_rows=global_rows,
+                max_seq_len=training_config.max_seq_len,
+                grad_accum=training_config.gradient_accumulation_steps,
+                strategy=parallel_config.sharding_strategy,
+                hbm_gb=data_opts["hbm_gb"],
+                opt_state_bytes=plan_opt_bytes,
+                carry_cast=training_config.carry_cast_params,
+                exclude_axes=exclude)
+        except planner_lib.NoFeasiblePlanError as plan_err:
+            raise SystemExit(f"--mesh auto: {plan_err}") from plan_err
+        plan_record["auto"] = True
+        chosen = plan_record["chosen"]
+        parallel_config = dataclasses.replace(
+            parallel_config, mesh=planner_lib.mesh_config_for(chosen))
+        if chosen["batch_per_shard"] != training_config.batch_size:
+            training_config = dataclasses.replace(
+                training_config, batch_size=chosen["batch_per_shard"])
+        if jax.process_index() == 0:
+            for line in planner_lib.render_table(plan_record):
+                print(line, flush=True)
+    else:
+        try:
+            resolved = parallel_config.mesh.resolve(n_devices)
+        except ValueError as mesh_err:
+            raise SystemExit(f"mesh: {mesh_err}") from mesh_err
+        sizes = dict(zip(mesh_lib.MESH_AXES, resolved))
+        feas_err = planner_lib.feasibility_error(
+            sizes, plan_mc, n_devices=n_devices,
+            global_rows=training_config.batch_size
+            * sizes[mesh_lib.DATA_AXIS] * sizes[mesh_lib.FSDP_AXIS],
+            max_seq_len=training_config.max_seq_len)
+        if feas_err:
+            raise SystemExit(
+                f"mesh: infeasible split {tuple(resolved)} "
+                f"({'x'.join(mesh_lib.MESH_AXES)}): {feas_err} — fix the "
+                f"--mesh_* split, or let --mesh auto pick one")
+
     trainer = Trainer(model_config, training_config, parallel_config)
     main = trainer.is_main_process
     if main:
@@ -1042,6 +1139,11 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         recorder=recorder,
     )
     logger.tokens_seen = tokens_seen
+
+    if plan_record is not None:
+        # The ranked table already printed at plan time (before the mesh
+        # existed); this persists the record to the JSONL sinks.
+        logger.log_record(plan_record)
 
     # --- nan_scan debug mode: bisect the first non-finite layer, exit --
     if data_opts["nan_scan"]:

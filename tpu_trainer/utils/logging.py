@@ -47,6 +47,21 @@ _PEAK_FLOPS = {
 _DEFAULT_PEAK = 275e12   # assume v4 when the kind string is unrecognized
 
 
+def peak_flops_for_kind(device_kind: str) -> float:
+    """Peak bf16 FLOP/s for a ``device_kind`` string (best-effort match).
+
+    Split out of :func:`device_peak_flops` so offline consumers — the mesh
+    auto-planner planning for a device kind the process doesn't own
+    (``tools/plan --device-kind``) — share the exact lookup the live
+    telemetry uses.
+    """
+    kind = (device_kind or "").lower().replace(" ", "")
+    for key, flops in _PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return _DEFAULT_PEAK
+
+
 def device_peak_flops(device: Optional[jax.Device] = None) -> float:
     """Peak bf16 FLOP/s of one chip (best-effort from device_kind).
 
@@ -55,11 +70,7 @@ def device_peak_flops(device: Optional[jax.Device] = None) -> float:
     actually own (``jax.devices()[0]`` is host 0's first chip everywhere).
     """
     device = device or jax.local_devices()[0]
-    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
-    for key, flops in _PEAK_FLOPS.items():
-        if key in kind:
-            return flops
-    return _DEFAULT_PEAK
+    return peak_flops_for_kind(getattr(device, "device_kind", ""))
 
 
 def flops_per_token(config: GPTConfig, seq_len: Optional[int] = None) -> float:
