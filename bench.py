@@ -138,10 +138,21 @@ def _build_parser():
                    dest="mean_doc_len", default=None,
                    help="--packed: mean synthetic document length "
                         "(default seq_len // 4)")
+    p.add_argument("--moe", action="store_true",
+                   help="MoE routing A/B: dense FFN vs capacity-einsum vs "
+                        "dropless grouped-matmul experts at matched active "
+                        "params over a skewed token stream; reports tok/s "
+                        "plus router drop_frac/max_group_frac per lane "
+                        "(uses --num-experts [default 8] and --moe-top-k "
+                        "[default 2])")
     p.add_argument("--table", action="store_true",
                    help="run the method x chips scaling table")
     p.add_argument("--update-results", action="store_true",
                    help="rewrite the scaling table in benchmarks/results.md")
+    p.add_argument("--update-md", action="store_true",
+                   help="splice the current lane's table into "
+                        "benchmarks/results.md (alias of --update-results "
+                        "for the --moe lane)")
     p.add_argument("--validate", action="store_true",
                    help="run the on-hardware validation lane "
                         "(tpu_trainer.validate) instead of benchmarking")
@@ -734,6 +745,198 @@ def update_packing_md(result) -> None:
     print(f"wrote packing table to {_RESULTS_MD}", file=sys.stderr)
 
 
+def run_moe(args, mesh_cfg):
+    """Dense-FFN vs capacity-einsum vs dropless MoE A/B (``--moe``).
+
+    Three lanes at matched ACTIVE params per token over the same
+    deterministic SKEWED token stream: tokens are drawn from a handful of
+    vocab ids, so the hidden states — and with them the router logits —
+    are near-identical across the batch and the top-k choices pile onto a
+    few experts.  That is the worst case for capacity routing (every
+    token beyond ``C = ceil(k*T/E * capacity_factor)`` per hot expert is
+    dropped, while the cold experts' slots burn dense matmul time empty)
+    and exactly the case the grouped matmul exists for: the dropless lane
+    computes the same k*T routed rows with no slot padding and no drops.
+
+    - ``dense``: no routing; FFN widened to ``top_k * intermediate`` so
+      the per-token matmul FLOPs match the MoE lanes' active params.
+    - ``capacity``: ``moe_impl="capacity"``, ``moe_dispatch="einsum"``
+      (the dense one-hot dispatch/combine path).
+    - ``dropless``: ``moe_impl="dropless"`` — argsort/bincount into
+      grouped matmuls (ops/grouped_matmul.py).
+
+    Each MoE lane also runs one (untimed) telemetry step and reports the
+    router's ``drop_frac`` / ``max_group_frac`` so the table shows WHY
+    the throughput differs, not just that it does.
+    """
+    import dataclasses as _dc
+
+    import jax  # noqa: F401  (platform init side effect)
+    import numpy as np
+
+    from tpu_trainer.parallel.mesh import make_mesh
+    from tpu_trainer.training.config import TrainingConfig
+    from tpu_trainer.training.trainer import ParallelConfig, Trainer
+    from tpu_trainer.utils import telemetry as telemetry_lib
+
+    seq_len = args.seq_len
+    mesh = make_mesh(mesh_cfg)
+    num_experts = args.num_experts or 8
+    top_k = args.moe_top_k if args.moe_top_k > 1 else 2
+    model_flags = _parse_model_flags(args.model_flag)
+
+    moe_cfg = _bench_model_config(
+        args.model_size, seq_len=seq_len, use_flash=bool(args.flash),
+        remat=_remat(args), num_experts=num_experts, moe_top_k=top_k,
+        model_flags=model_flags)
+    dense_cfg = _bench_model_config(
+        args.model_size, seq_len=seq_len, use_flash=bool(args.flash),
+        remat=_remat(args), model_flags=model_flags)
+    dense_cfg = _dc.replace(
+        dense_cfg, intermediate_size=top_k * moe_cfg.intermediate_size)
+    lane_cfgs = {
+        "dense": dense_cfg,
+        "capacity": _dc.replace(moe_cfg, moe_impl="capacity",
+                                moe_dispatch="einsum"),
+        "dropless": _dc.replace(moe_cfg, moe_impl="dropless"),
+    }
+
+    training_config = TrainingConfig(
+        batch_size=args.batch_size,
+        max_seq_len=seq_len,
+        gradient_accumulation_steps=args.accum,
+        mixed_precision="bf16",
+        log_interval=10**9,
+    )
+
+    lanes = {}
+    for lane, model_config in lane_cfgs.items():
+        trainer = Trainer(model_config, training_config,
+                          ParallelConfig(mesh_cfg,
+                                         args.strategy or "replicated"),
+                          mesh=mesh)
+        rows = args.batch_size * args.accum * trainer.dp_size \
+            // trainer.process_count
+        # Skewed stream: a 4-id vocab slice keeps the router's top-k
+        # concentrated; deterministic so every lane sees the same tokens.
+        rng = np.random.default_rng(23)
+
+        def next_batch():
+            return rng.integers(0, 4, size=(rows, seq_len), dtype=np.int32)
+
+        state = trainer.init_state()
+        for _ in range(2):  # warmup: compile + stabilize
+            state, metrics = trainer.train_step(state, next_batch())
+        float(metrics["loss"])
+
+        router = {}
+        if model_config.num_experts:
+            # One untimed telemetry step (separate executable) for the
+            # router health columns of the table.
+            state, metrics = trainer.train_step(state, next_batch(),
+                                                telemetry=True)
+            flat = telemetry_lib.flatten_scalars(metrics["telemetry"])
+
+            def _layer_vals(key, flat=flat):
+                pfx = f"telemetry/router/{key}/"
+                return [v for k, v in flat.items() if k.startswith(pfx)]
+
+            router = {
+                "drop_frac": round(max(_layer_vals("drop_frac")), 4),
+                "max_group_frac": round(max(_layer_vals("max_group_frac")),
+                                        4),
+                "entropy": round(
+                    sum(_layer_vals("entropy"))
+                    / max(len(_layer_vals("entropy")), 1), 4),
+            }
+
+        window_elapsed = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                state, metrics = trainer.train_step(state, next_batch())
+            float(metrics["loss"])  # end-of-window device sync
+            window_elapsed.append(time.perf_counter() - t0)
+        elapsed = min(window_elapsed)
+        lanes[lane] = {
+            "tok_per_sec": round(
+                args.steps * trainer.tokens_per_step / elapsed, 1),
+            "window_elapsed_s": [round(w, 3) for w in window_elapsed],
+            **router,
+        }
+
+    speedup = (lanes["dropless"]["tok_per_sec"]
+               / max(lanes["capacity"]["tok_per_sec"], 1e-9))
+    return {
+        "metric": "moe_dropless_tok_per_sec",
+        "value": lanes["dropless"]["tok_per_sec"],
+        "unit": "tok/s",
+        "dense": lanes["dense"],
+        "capacity": lanes["capacity"],
+        "dropless": lanes["dropless"],
+        "dropless_vs_capacity": round(speedup, 2),
+        "num_experts": num_experts,
+        "moe_top_k": top_k,
+        "model_size": args.model_size,
+        "batch_size": args.batch_size,
+        "seq_len": seq_len,
+        "steps": args.steps,
+        "platform": next(iter(mesh.devices.flat)).platform,
+        "n_chips": mesh.size,
+    }
+
+
+_MOE_START = "<!-- moe-table:start -->"
+_MOE_END = "<!-- moe-table:end -->"
+
+
+def update_moe_md(result) -> None:
+    """Splice the --moe A/B into benchmarks/results.md (own marker block,
+    same mechanism as the scaling and packing tables)."""
+    header = (
+        f"Measured by `python bench.py --moe` — {result['model_size']}, "
+        f"{result['num_experts']} experts top-{result['moe_top_k']}, batch "
+        f"{result['batch_size']}/shard, seq {result['seq_len']}, skewed "
+        f"4-id token stream, platform {result['platform']} "
+        f"({time.strftime('%Y-%m-%d')}).\n\n"
+    )
+    lines = [
+        "| Lane | tok/s | drop_frac | max_group_frac | router entropy |",
+        "|---|---|---|---|---|",
+    ]
+    for lane in ("dense", "capacity", "dropless"):
+        r = result.get(lane)
+        if r is None:
+            continue
+
+        def _cell(key, r=r):
+            return f"{r[key]:.3f}" if key in r else "-"
+
+        lines.append(
+            f"| {lane} | {r['tok_per_sec']:,.0f} | {_cell('drop_frac')} "
+            f"| {_cell('max_group_frac')} | {_cell('entropy')} |"
+        )
+    table = "\n".join(lines) + (
+        f"\n\nThroughput ratio (dropless / capacity-einsum): "
+        f"**{result['dropless_vs_capacity']:.2f}x** — same params, same "
+        f"tokens; the capacity lane additionally DROPS "
+        f"{result['capacity'].get('drop_frac', 0):.1%} of its routed "
+        f"tokens on this skewed stream while dropless drops none."
+    )
+    block = f"{_MOE_START}\n{header}{table}\n{_MOE_END}"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if _MOE_START in text:
+        pre = text.split(_MOE_START)[0]
+        post = text.split(_MOE_END)[1]
+        text = pre + block + post
+    else:
+        text += "\n## Dropless MoE\n\n" + block + "\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote MoE table to {_RESULTS_MD}", file=sys.stderr)
+
+
 def _chip_counts(n: int):
     c, out = 1, []
     while c <= n:
@@ -937,8 +1140,14 @@ def main() -> None:
     if args.packed:
         result = run_packed(args, mesh_cfg)
         print(json.dumps(result))
-        if args.update_results:
+        if args.update_results or args.update_md:
             update_packing_md(result)
+        return
+    if args.moe:
+        result = run_moe(args, mesh_cfg)
+        print(json.dumps(result))
+        if args.update_results or args.update_md:
+            update_moe_md(result)
         return
     detail = run_bench(
         model_size=args.model_size, batch_size=args.batch_size,

@@ -534,3 +534,61 @@ class TestPlanSection:
         assert analyze.main([new, "--compare", base]) == 0
         assert analyze.main([new, "--compare", base,
                              "--plan-tol", "0.05"]) == 1
+
+
+class TestRouterSection:
+    """MoE router report + the dropless drop_frac gate (ISSUE 12)."""
+
+    @staticmethod
+    def _router_records(drop=0.0, dropless=1.0):
+        recs = _run_records()
+        for r in recs:
+            if r["kind"] == "train":
+                for layer in ("L00", "L01"):
+                    r[f"telemetry/router/entropy/{layer}"] = 1.3
+                    r[f"telemetry/router/drop_frac/{layer}"] = drop
+                    r[f"telemetry/router/max_group_frac/{layer}"] = 0.4
+                    r[f"telemetry/router/dropless/{layer}"] = dropless
+                    r[f"telemetry/router/load/{layer}/max"] = 0.4
+                    r[f"telemetry/router/load/{layer}/min"] = 0.1
+        return recs
+
+    def test_summarize_and_render_router(self, tmp_path):
+        report = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "run.jsonl", self._router_records(drop=0.1,
+                                                         dropless=0.0))))
+        ro = report["router"]
+        assert ro["dropless"] is False
+        assert ro["drop_frac_max"] == pytest.approx(0.1)
+        assert ro["entropy"]["p50"] == pytest.approx(1.3)
+        assert ro["max_group_frac"]["p90"] == pytest.approx(0.4)
+        text = "\n".join(analyze.render(report))
+        assert "router  capacity" in text
+        assert "TOKENS DROPPED" not in text
+
+    def test_dropless_run_with_drops_renders_flag(self, tmp_path):
+        report = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "run.jsonl", self._router_records(drop=0.05))))
+        assert report["router"]["dropless"] is True
+        text = "\n".join(analyze.render(report))
+        assert "TOKENS DROPPED ON DROPLESS RUN" in text
+
+    def test_gate_fails_dropless_run_with_drops(self, tmp_path):
+        base = _write(tmp_path / "b.jsonl", self._router_records())
+        good = _write(tmp_path / "g.jsonl", self._router_records())
+        assert analyze.main([good, "--compare", base]) == 0
+        bad = _write(tmp_path / "f.jsonl", self._router_records(drop=0.02))
+        assert analyze.main([bad, "--compare", base]) == 1
+        # A loosened absolute budget lets the same run through.
+        assert analyze.main([bad, "--compare", base,
+                             "--moe-drop-tol", "0.05"]) == 0
+
+    def test_gate_skips_capacity_runs(self, tmp_path):
+        # Capacity-mode drops are a tuning choice, not a bug: SKIP even at
+        # large drop_frac. Runs without router telemetry SKIP too.
+        base = _write(tmp_path / "b.jsonl", _run_records())
+        capacity = _write(tmp_path / "c.jsonl",
+                          self._router_records(drop=0.5, dropless=0.0))
+        assert analyze.main([capacity, "--compare", base]) == 0
+        plain = _write(tmp_path / "p.jsonl", _run_records())
+        assert analyze.main([plain, "--compare", base]) == 0

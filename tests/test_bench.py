@@ -128,6 +128,64 @@ class TestPackedLane:
             assert 0.0 < r[lane]["non_pad_frac"] <= 1.0
 
 
+class TestMoELane:
+    """--moe dense/capacity/dropless A/B (ISSUE 12)."""
+
+    def _result(self):
+        return {
+            "metric": "moe_dropless_tok_per_sec", "value": 200.0,
+            "unit": "tok/s",
+            "dense": {"tok_per_sec": 150.0, "window_elapsed_s": [1.0]},
+            "capacity": {"tok_per_sec": 100.0, "window_elapsed_s": [1.0],
+                         "drop_frac": 0.47, "max_group_frac": 0.3,
+                         "entropy": 2.07},
+            "dropless": {"tok_per_sec": 200.0, "window_elapsed_s": [1.0],
+                         "drop_frac": 0.0, "max_group_frac": 0.49,
+                         "entropy": 2.07},
+            "dropless_vs_capacity": 2.0, "num_experts": 8, "moe_top_k": 2,
+            "model_size": "tiny", "batch_size": 1, "seq_len": 128,
+            "steps": 1, "platform": "cpu", "n_chips": 1,
+        }
+
+    def test_update_moe_md_is_idempotent(self, tmp_path, monkeypatch):
+        target = tmp_path / "results.md"
+        target.write_text("# Results\n\nprologue\n")
+        monkeypatch.setattr(bench, "_RESULTS_MD", str(target))
+
+        result = self._result()
+        bench.update_moe_md(result)
+        first = target.read_text()
+        assert bench._MOE_START in first and "prologue" in first
+        assert "**2.00x**" in first
+        result["dropless_vs_capacity"] = 3.0
+        bench.update_moe_md(result)
+        second = target.read_text()
+        assert second.count(bench._MOE_START) == 1
+        assert "**3.00x**" in second and "**2.00x**" not in second
+
+    @pytest.mark.slow  # three trainer compiles (~1 min); splice test stays fast
+    def test_run_moe_tiny(self):
+        import argparse
+
+        from tpu_trainer.parallel.mesh import MeshConfig
+
+        args = argparse.Namespace(
+            model_size="tiny", batch_size=1, seq_len=128, steps=1,
+            accum=1, flash=False, remat=False, strategy="replicated",
+            num_experts=4, moe_top_k=2, model_flag=[],
+        )
+        r = bench.run_moe(args, MeshConfig(data=-1, fsdp=1))
+        json.dumps(r)  # stdout contract: one JSON line
+        assert r["metric"] == "moe_dropless_tok_per_sec"
+        for lane in ("dense", "capacity", "dropless"):
+            assert r[lane]["tok_per_sec"] > 0
+        # The whole point: the dropless lane never drops a token, while
+        # the skewed stream forces the capacity lane to.
+        assert r["dropless"]["drop_frac"] == 0.0
+        assert r["capacity"]["drop_frac"] > 0.0
+        assert 0.0 < r["dropless"]["max_group_frac"] <= 1.0
+
+
 class TestMeshPlanLane:
     """--mesh auto + the mesh_plan validation loop (ISSUE 11)."""
 

@@ -91,6 +91,16 @@ class GPTConfig:
     # expert parallelism. Same semantics every way, pinned by oracle
     # tests.
     moe_dispatch: str = "auto"
+    # Routing discipline (models/moe.py): "capacity" (default) is the
+    # Switch/GShard scheme above — fixed per-expert slots C, tokens past
+    # capacity dropped, dense [E, C, H] expert matmuls (moe_dispatch picks
+    # how tokens reach the slots). "dropless" is MegaBlocks-style
+    # (arXiv:2211.15841): token-choices are argsorted into expert order
+    # and all three SwiGLU projections run as grouped matmuls
+    # (ops/grouped_matmul.gmm) sized by the true per-expert counts — no
+    # capacity_factor, drop_frac == 0 by construction, and expert compute
+    # scales with the tokens actually routed instead of E*C.
+    moe_impl: str = "capacity"
     moe_aux_weight: float = 0.01
     router_z_weight: float = 0.0
 
@@ -267,6 +277,11 @@ class GPTConfig:
             raise ValueError(
                 f"unknown moe_dispatch {self.moe_dispatch!r}; "
                 f"choose auto, gather, or einsum"
+            )
+        if self.moe_impl not in ("capacity", "dropless"):
+            raise ValueError(
+                f"unknown moe_impl {self.moe_impl!r}; "
+                f"choose capacity or dropless"
             )
         if self.pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(

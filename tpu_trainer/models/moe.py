@@ -37,6 +37,19 @@ Mechanics (Switch Transformer, arXiv:2101.03961; top-2 per GShard/ST-MoE):
   over first-choice assignment fractions) plus ``router_z_weight *
   mean(logsumexp(logits)^2)`` (ST-MoE z-loss, arXiv:2202.08906 — keeps
   router logits from drifting into softmax saturation).
+
+``GPTConfig.moe_impl="dropless"`` replaces the capacity machinery above
+with MegaBlocks-style token-dropless routing (arXiv:2211.15841): the
+``T*k`` token-choice rows are permuted into expert order (one stable
+argsort), per-expert group sizes come from a bincount of the routing
+(no capacity ``C`` exists, so ``drop_frac`` is 0 by construction), and
+all three SwiGLU projections run as grouped matmuls
+(``ops/grouped_matmul.gmm``) whose compute scales with the tokens each
+expert actually received. The inverse permutation gathers rows back and
+the top-k gates weight the combine. Router, gates, and aux/z losses are
+shared with the capacity path; telemetry reports the TRUE post-routing
+load (the bincount) rather than pre-capacity first-choice fractions,
+plus a ``max_group_frac`` collapse indicator.
 """
 
 from __future__ import annotations
@@ -49,6 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.ops.grouped_matmul import gmm
 from tpu_trainer.utils import telemetry
 
 
@@ -172,13 +186,6 @@ class MoEMLP(nn.Module):
         b, s, H = x.shape
         T = b * s
         I = cfg.intermediate_size
-        if T <= 2 * E:
-            # Tiny-token regime (single-token KV decode: T = batch): the
-            # statistical capacity rule degenerates (C~1 would zero out any
-            # token colliding on an expert). Give every token a slot.
-            C = T
-        else:
-            C = max(1, math.ceil(k * T / E * cfg.expert_capacity_factor))
 
         xt = x.reshape(T, H)
 
@@ -206,6 +213,37 @@ class MoEMLP(nn.Module):
             z = jax.nn.logsumexp(router_logits, axis=-1)        # [T]
             aux = aux + cfg.router_z_weight * jnp.mean(z * z)
 
+        dtype = cfg.compute_dtype
+        entropy = -jnp.sum(mean_prob * jnp.log(mean_prob + 1e-9))
+
+        def ffn_param(name, shape):
+            return self.param(
+                name, nn.initializers.normal(cfg.initializer_range), shape,
+                cfg.params_dtype,
+            ).astype(dtype)
+
+        w_gate = ffn_param("experts_gate", (E, H, I))
+        w_up = ffn_param("experts_up", (E, H, I))
+        w_down = ffn_param("experts_down", (E, I, H))
+        act = {"silu": nn.silu, "gelu": nn.gelu}[cfg.activation]
+
+        if cfg.moe_impl == "dropless":
+            out = self._dropless_ffn(
+                xt, gate_idx, gates, entropy, w_gate, w_up, w_down, act,
+            ).reshape(b, s, H)
+            from tpu_trainer.models.gpt import _residual_dropout
+
+            out = _residual_dropout(cfg, self, out, deterministic)
+            return out, aux.astype(jnp.float32)
+
+        if T <= 2 * E:
+            # Tiny-token regime (single-token KV decode: T = batch): the
+            # statistical capacity rule degenerates (C~1 would zero out any
+            # token colliding on an expert). Give every token a slot.
+            C = T
+        else:
+            C = max(1, math.ceil(k * T / E * cfg.expert_capacity_factor))
+
         # Position of each token-choice in its expert's queue, counted in
         # choice-major order (all first choices precede any second choice,
         # so capacity overflow drops second choices first); drop past C.
@@ -215,19 +253,26 @@ class MoEMLP(nn.Module):
         keep_k = (pos_k < C).astype(jnp.float32) * assign_k
         pos_idx = jnp.sum(pos_k * assign_k, axis=-1).astype(jnp.int32)
 
-        dtype = cfg.compute_dtype
         kept = jnp.sum(keep_k, axis=-1) > 0                     # [T, k]
         if telemetry.capturing():
             # Router health (Switch-Transformer diagnostics), popped by the
             # enclosing TransformerBlock into its per-layer telemetry:
             # first-choice load fractions (sum to 1 by construction),
             # entropy of the mean routing distribution (log E when the
-            # router is uniform, 0 when it collapses onto one expert), and
-            # the fraction of token-choices dropped at capacity.
+            # router is uniform, 0 when it collapses onto one expert), the
+            # fraction of token-choices dropped at capacity, and the
+            # heaviest expert's share of KEPT token-choices (collapse
+            # shows up here before the drops do). ``dropless`` marks the
+            # impl so the analyzer can gate drop_frac > 0 as a bug on
+            # dropless runs but expected behavior here.
+            kept_counts = jnp.sum(keep_k, axis=(0, 1))          # [E]
             telemetry.record("router", {
                 "load": frac,
-                "entropy": -jnp.sum(mean_prob * jnp.log(mean_prob + 1e-9)),
+                "entropy": entropy,
                 "drop_frac": 1.0 - jnp.mean(kept.astype(jnp.float32)),
+                "max_group_frac": (jnp.max(kept_counts)
+                                   / jnp.maximum(jnp.sum(kept_counts), 1.0)),
+                "dropless": jnp.zeros((), jnp.float32),
             })
         mode = cfg.moe_dispatch
         if mode == "auto":
@@ -280,18 +325,7 @@ class MoEMLP(nn.Module):
                 "tec,th->ech", dispatch.astype(dtype), xt.astype(dtype)
             )  # [E, C, H]
 
-        def ffn_param(name, shape):
-            return self.param(
-                name, nn.initializers.normal(cfg.initializer_range), shape,
-                cfg.params_dtype,
-            ).astype(dtype)
-
-        w_gate = ffn_param("experts_gate", (E, H, I))
-        w_up = ffn_param("experts_up", (E, H, I))
-        w_down = ffn_param("experts_down", (E, I, H))
-
         hmid = jnp.einsum("ech,ehi->eci", expert_in, w_gate)
-        act = {"silu": nn.silu, "gelu": nn.gelu}[cfg.activation]
         hmid = act(hmid) * jnp.einsum("ech,ehi->eci", expert_in, w_up)
         expert_out = jnp.einsum("eci,eih->ech", hmid, w_down)   # [E, C, H]
 
@@ -310,3 +344,64 @@ class MoEMLP(nn.Module):
 
         out = _residual_dropout(cfg, self, out, deterministic)
         return out, aux.astype(jnp.float32)
+
+    def _dropless_ffn(self, xt, gate_idx, gates, entropy,
+                      w_gate, w_up, w_down, act):
+        """Token-dropless expert FFN over grouped matmuls.
+
+        One stable argsort of the ``T*k`` token-choice rows by expert id
+        builds the grouped layout (stability makes the permutation a pure
+        function of the routing — exact-resume replays it bit-identically);
+        ``bincount`` gives the true per-expert group sizes. Each SwiGLU
+        projection is one ``gmm`` whose compute is exactly
+        ``sum(counts) = k*T`` rows — no capacity padding, no drops. The
+        inverse permutation is a second argsort (of the first), and the
+        gates weight the per-choice rows back into token order.
+
+        Mesh composition: on a multi-device mesh the jnp twin runs
+        (``use_kernel=False``) so GSPMD partitions the ragged dot like any
+        other op; the Pallas kernel drives the single-device TPU path. A
+        shard_mapped gmm with an explicit EP all-to-all is the planned
+        follow-up (ROADMAP item 4).
+        """
+        cfg = self.config
+        E = cfg.num_experts
+        k = cfg.moe_top_k
+        T = xt.shape[0]
+        dtype = cfg.compute_dtype
+
+        flat_expert = gate_idx.astype(jnp.int32).reshape(-1)    # [T*k]
+        counts = jnp.bincount(flat_expert, length=E)            # [E]
+        perm = jnp.argsort(flat_expert)                         # stable
+        inv_perm = jnp.argsort(perm)
+
+        from tpu_trainer.parallel import context as ctx_lib
+
+        mesh = ctx_lib.current_mesh()
+        use_kernel = False if (mesh is not None and mesh.size > 1) else None
+
+        def grouped(lhs, w):
+            return gmm(lhs, w, counts, use_kernel=use_kernel)
+
+        grouped_in = xt.astype(dtype)[perm // k]                # [T*k, H]
+        mid = act(grouped(grouped_in, w_gate)) * grouped(grouped_in, w_up)
+        grouped_out = grouped(mid, w_down)                      # [T*k, H]
+        rows = grouped_out[inv_perm].reshape(T, k, -1)
+        out = jnp.sum(rows * gates[..., None].astype(dtype), axis=1)
+
+        if telemetry.capturing():
+            # True post-routing load (the bincount — what each expert
+            # actually computed), not pre-capacity first-choice fractions;
+            # max_group_frac is the collapse indicator (1/E when balanced,
+            # -> 1.0 as the router collapses onto one expert). drop_frac
+            # is structurally zero — the analyzer FAILs a dropless run
+            # that ever reports otherwise.
+            load = counts.astype(jnp.float32) / float(k * T)
+            telemetry.record("router", {
+                "load": load,
+                "entropy": entropy,
+                "drop_frac": jnp.zeros((), jnp.float32),
+                "max_group_frac": jnp.max(load),
+                "dropless": jnp.ones((), jnp.float32),
+            })
+        return out

@@ -229,11 +229,15 @@ def build_core(
     seq_bytes = accum * layers * 3 * ring_sendrecv_bytes(kv_shard, sp)
 
     # expert axis: dispatch + combine all-to-alls, forward and backward
-    # (4 total per layer per micro), on the capacity-padded token buffer.
+    # (4 total per layer per micro).  Capacity routing moves the padded
+    # E*C slot buffer (top_k * capacity_factor rows per token); dropless
+    # routing moves exactly the k*T routed rows — no padding factor.
     expert_bytes = 0.0
     if mc.num_experts > 0 and ep > 1:
-        tok_payload = (rows * seq_local * mc.moe_top_k
-                       * mc.expert_capacity_factor * hidden * act_bytes)
+        routed_scale = (mc.moe_top_k if mc.moe_impl == "dropless"
+                        else mc.moe_top_k * mc.expert_capacity_factor)
+        tok_payload = (rows * seq_local * routed_scale
+                       * hidden * act_bytes)
         expert_bytes = (
             accum * layers * 4 * all_to_all_bytes(tok_payload, ep))
 

@@ -204,7 +204,8 @@ def estimate_memory(
     - activations per micro-batch:
       ``rows * seq_local * layers_local * (4*hidden + 2*inter_local)``
       in compute dtype, plus a 4x-hidden embed/head working set; the MoE
-      FFN term scales by ``top_k * capacity_factor``.
+      FFN term scales by ``top_k * capacity_factor`` (capacity routing)
+      or by ``top_k`` alone (dropless routing — no slot padding).
 
     Cross-check the winner against the XLA ``memory_analysis`` numbers in
     the ``cost_analysis`` record — this estimate is for *pruning*
@@ -239,8 +240,13 @@ def estimate_memory(
     layers_local = mc.num_layers // st if st > 1 else mc.num_layers
     inter_local = (mc.intermediate_size // tp
                    if mc.intermediate_size % tp == 0 else mc.intermediate_size)
-    mlp_scale = (mc.moe_top_k * mc.expert_capacity_factor
-                 if mc.num_experts > 0 else 1.0)
+    if mc.num_experts > 0:
+        # Capacity routing materialises the padded E*C slot buffer;
+        # dropless holds exactly the k*T routed rows.
+        mlp_scale = (mc.moe_top_k if mc.moe_impl == "dropless"
+                     else mc.moe_top_k * mc.expert_capacity_factor)
+    else:
+        mlp_scale = 1.0
     per_token = 4 * mc.hidden_size + 2 * inter_local * mlp_scale
     activations = act_bytes * batch_size * seq_local * (
         layers_local * per_token + 4 * mc.hidden_size)

@@ -337,6 +337,37 @@ def summarize(records: List[dict]) -> dict:
                        if any(k.startswith("telemetry/") for k in r)]
     if telemetry_steps:
         report["telemetry_steps"] = len(telemetry_steps)
+
+    # MoE router health.  models/moe.py records per-layer router stats that
+    # utils/telemetry.flatten_scalars spreads into
+    # ``telemetry/router/<key>/L..`` train-record scalars: ``entropy``
+    # (routing distribution), ``drop_frac`` (tokens past capacity — 0 by
+    # construction under moe_impl="dropless"), ``max_group_frac`` (largest
+    # expert's share of routed tokens; 1/E is perfectly balanced, ~1.0 is a
+    # collapsed router), and a ``dropless`` 0/1 marker.  drop_frac and
+    # max_group_frac aggregate as max-over-layers per record so one bad
+    # layer can't hide behind healthy siblings.
+    def _router_vals(rec: dict, key: str) -> List[float]:
+        pfx = f"telemetry/router/{key}/"
+        return [float(v) for k, v in rec.items() if k.startswith(pfx)]
+
+    router_recs = [r for r in train
+                   if any(k.startswith("telemetry/router/") for k in r)]
+    if router_recs:
+        drops = [max(_router_vals(r, "drop_frac") or [0.0])
+                 for r in router_recs]
+        imbal = [max(_router_vals(r, "max_group_frac") or [0.0])
+                 for r in router_recs]
+        last_entropy = _router_vals(router_recs[-1], "entropy")
+        dl_marks = _router_vals(router_recs[-1], "dropless")
+        report["router"] = {
+            "n": len(router_recs),
+            "dropless": bool(dl_marks) and min(dl_marks) >= 0.5,
+            "entropy": _stats(last_entropy),
+            "drop_frac": _stats(drops),
+            "drop_frac_max": max(drops) if drops else None,
+            "max_group_frac": _stats(imbal),
+        }
     return report
 
 
@@ -418,6 +449,20 @@ def render(report: dict) -> List[str]:
             + (f" | median err {_fmt(err * 100, 1)}%"
                if err is not None else "")
             + (f" -> {pl.get('bound')}-bound" if pl.get("bound") else ""))
+    ro = report.get("router")
+    if ro:
+        ent = ro.get("entropy")
+        drop = ro.get("drop_frac")
+        imbal = ro.get("max_group_frac")
+        flag = ""
+        if ro.get("dropless") and drop and drop["p90"] > 0:
+            flag = "  ** TOKENS DROPPED ON DROPLESS RUN **"
+        lines.append(
+            f"router  {'dropless' if ro.get('dropless') else 'capacity'}"
+            f" | entropy p50 {_fmt(ent['p50'], 3) if ent else '-'}"
+            f" | drop_frac p90 {_fmt(drop['p90'], 4) if drop else '-'}"
+            f" | max_group_frac p90"
+            f" {_fmt(imbal['p90'], 3) if imbal else '-'}{flag}")
     r = report.get("recompiles")
     if r:
         flag = "  ** RECOMPILE STORM (loader shape churn?) **" if r["storm"] else ""
@@ -493,7 +538,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             recovery_tol: float = 120.0,
             grow_tol: float = 120.0,
             pack_tol: float = 0.05,
-            plan_tol: float = 0.30) -> List[dict]:
+            plan_tol: float = 0.30,
+            moe_drop_tol: float = 0.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -543,6 +589,16 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
     baseline — a cost model that's 50% off misranks meshes whether or not
     it was 50% off last week. SKIP when the run carries no mesh_plan
     record with a measured step time.
+
+    ``moe_drop_frac`` is ABSOLUTE against a fixed budget too, and the
+    budget defaults to zero: a run whose router telemetry says
+    ``moe_impl="dropless"`` (the ``dropless`` marker scalar) must log
+    ``drop_frac == 0`` at every captured step — dropless routing admits
+    every token by construction (models/moe.py ``_dropless_ffn``), so any
+    nonzero drop means the permutation/bincount path is broken. FAIL when
+    the worst captured drop_frac exceeds ``moe_drop_tol``; SKIP for
+    capacity-mode or non-MoE runs (drops there are a tuning choice, not a
+    bug).
     """
     def get(report, *keys):
         cur = report
@@ -653,6 +709,25 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "base": get(base, "plan", "plan_error_frac"),
             "new": round(new_plan_err, 4),
             "tolerance_frac": plan_tol,
+            "absolute": True,
+        })
+
+    # Dropless-MoE correctness gate: only gates runs that SAY they are
+    # dropless; the worst drop_frac across captured steps must stay at (or
+    # under) the absolute budget, baseline irrelevant.
+    new_drop_max = (get(new, "router", "drop_frac_max")
+                    if get(new, "router", "dropless") else None)
+    if new_drop_max is None:
+        verdicts.append({"metric": "moe_drop_frac", "verdict": "SKIP",
+                         "base": get(base, "router", "drop_frac_max"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "moe_drop_frac",
+            "verdict": "FAIL" if new_drop_max > moe_drop_tol + eps else "PASS",
+            "base": get(base, "router", "drop_frac_max"),
+            "new": round(new_drop_max, 6),
+            "tolerance_frac": moe_drop_tol,
             "absolute": True,
         })
 
@@ -788,6 +863,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "step-time error is >= this fraction (default "
                              "0.30); SKIP when the run carries no mesh_plan "
                              "record with a measured step time")
+    parser.add_argument("--moe-drop-tol", type=float, default=0.0,
+                        help="ABSOLUTE gate on dropless-MoE routing: FAIL "
+                             "if a run whose router telemetry is marked "
+                             "dropless logged drop_frac above this value "
+                             "at any captured step (default 0.0 — dropless "
+                             "means dropless); SKIP for capacity-mode or "
+                             "non-MoE runs")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -811,7 +893,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             overhead_tol=args.overhead_tol,
             serve_lat_tol=args.serve_lat_tol,
             recovery_tol=args.recovery_tol, grow_tol=args.grow_tol,
-            pack_tol=args.pack_tol, plan_tol=args.plan_tol)
+            pack_tol=args.pack_tol, plan_tol=args.plan_tol,
+            moe_drop_tol=args.moe_drop_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

@@ -301,6 +301,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                    help="GPipe microbatches per step (default: one per stage)")
     p.add_argument("--num_experts", type=int, default=None,
                    help="> 0 turns every block's FFN into a routed MoE")
+    p.add_argument("--moe_impl", type=str, default=None,
+                   choices=["capacity", "dropless"],
+                   help="MoE routing discipline: fixed-capacity slots with "
+                        "token dropping, or dropless grouped-matmul experts")
     p.add_argument("--num_kv_heads", type=int, default=None,
                    help="grouped-query attention: K/V heads (< num_heads "
                         "shrinks the KV cache by the group factor)")
@@ -413,6 +417,8 @@ def resolve_configs(args, mode: str):
         overrides["max_seq_len"] = args.seq_len
     if args.num_experts is not None:
         overrides["num_experts"] = args.num_experts
+    if args.moe_impl is not None:
+        overrides["moe_impl"] = args.moe_impl
     if args.num_kv_heads is not None:
         overrides["num_kv_heads"] = args.num_kv_heads
     if args.gradient_checkpointing:
