@@ -16,21 +16,30 @@ Workloads (``--workload``):
 - ``shared_prefix`` — every prompt opens with the same system-prompt
   prefix: the recompute-per-request worst case prefix caching exists to
   fix.
+- ``repetitive`` — prompts built from a short repeated motif, so greedy
+  continuations loop: the workload speculative decoding's n-gram
+  (prompt-lookup) drafter exists for.
 
 ``--trace FILE`` replays a recorded trace instead: JSONL, one request per
 line, ``{"prompt_len": int, "max_new": int, "arrival_time": float,
-"prefix_id": str, "prefix_len": int}`` (only ``prompt_len`` is required —
-length pairs from a real tokenizer log drop in directly; tokens are
-synthesized deterministically from ``--seed``, with requests sharing a
-``prefix_id`` sharing their first ``prefix_len`` tokens).
-``benchmarks/traces/sample_trace.jsonl`` is a checked-in example CI runs.
+"prefix_id": str, "prefix_len": int, "prompt_tokens": [int]}`` (only
+``prompt_len`` is required — length pairs from a real tokenizer log drop
+in directly; tokens are synthesized deterministically from ``--seed``,
+with requests sharing a ``prefix_id`` sharing their first ``prefix_len``
+tokens — while ``prompt_tokens``, as recorded by ``infer.py --serve
+--record_trace``, replays the REAL token ids when they fit the bench
+vocab). ``benchmarks/traces/sample_trace.jsonl`` is a checked-in example
+CI runs; ``benchmarks/traces/byte_trace.jsonl`` is a real byte-tokenizer
+recording the smoke gate replays.
 
 ``--ab`` runs the workload twice as an A/B pair — unchunked vs chunked
-for ``adversarial``, prefix cache off vs on for ``shared_prefix`` — and
-``--update-md`` splices the lane table into ``benchmarks/results.md``.
+for ``adversarial``, prefix cache off vs on for ``shared_prefix``, spec
+decode off vs on when ``--spec`` is set — and ``--update-md`` splices
+the lane table into ``benchmarks/results.md``.
 
     python benchmarks/serve_bench.py [--requests 32] [--concurrency 8]
     python benchmarks/serve_bench.py --workload adversarial --ab --update-md
+    python benchmarks/serve_bench.py --workload repetitive --spec ngram --ab
     python benchmarks/serve_bench.py --trace benchmarks/traces/sample_trace.jsonl
     python benchmarks/serve_bench.py --smoke          # CPU CI gate
 
@@ -83,24 +92,40 @@ def _load_trace_file(path, *, vocab_size, max_seq_len, default_max_new,
             if not line or line.startswith("#"):
                 continue
             rec = json.loads(line)
-            plen = int(rec["prompt_len"])
+            real = rec.get("prompt_tokens")
+            plen = int(rec.get("prompt_len", len(real) if real else 0))
             mnew = int(rec.get("max_new", default_max_new))
             if plen < 1 or plen + mnew > max_seq_len:
                 raise ValueError(
                     f"{path}:{i + 1}: prompt_len {plen} + max_new {mnew} "
                     f"does not fit max_seq_len {max_seq_len}")
-            pfx_len = min(int(rec.get("prefix_len", 0)), plen)
-            pid = rec.get("prefix_id")
-            head = prefix(pid, pfx_len) if pid is not None and pfx_len else []
-            rs = np.random.RandomState((seed + 7919 * (i + 1)) & 0x7FFFFFFF)
-            tail = rs.randint(1, vocab_size, size=plen - len(head)).tolist()
+            if real is not None:
+                # A real recording (infer.py --serve --record_trace):
+                # replay the actual ids when the bench vocab covers them,
+                # else fall back to length-only synthesis below.
+                toks = [int(t) for t in real[:plen]]
+                if len(toks) != plen or (toks and max(toks) >= vocab_size):
+                    real = None
+            if real is not None:
+                prompt_ids = toks
+            else:
+                pfx_len = min(int(rec.get("prefix_len", 0)), plen)
+                pid = rec.get("prefix_id")
+                head = (prefix(pid, pfx_len)
+                        if pid is not None and pfx_len else [])
+                rs = np.random.RandomState(
+                    (seed + 7919 * (i + 1)) & 0x7FFFFFFF)
+                tail = rs.randint(
+                    1, vocab_size, size=plen - len(head)).tolist()
+                prompt_ids = [int(t) for t in head + tail]
             reqs.append(Request(
                 rid=len(reqs),
-                prompt=[int(t) for t in head + tail],
+                prompt=prompt_ids,
                 max_new_tokens=mnew,
                 sampling=SamplingParams(
                     temperature=float(rec.get("temperature", 0.0)),
                     top_k=int(rec.get("top_k", 0)),
+                    top_p=float(rec.get("top_p", 1.0)),
                     seed=int(rec.get("seed", 1000 + i)),
                 ),
                 arrival_time=float(rec.get("arrival_time", 0.0)),
@@ -140,7 +165,19 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-cache", action="store_true",
                    help="copy-on-write prefix sharing in the KV pool")
     p.add_argument("--workload", default="uniform",
-                   choices=("uniform", "adversarial", "shared_prefix"))
+                   choices=("uniform", "adversarial", "shared_prefix",
+                            "repetitive"))
+    p.add_argument("--spec", default="off",
+                   choices=("off", "ngram", "draft"),
+                   help="speculative decoding proposer; with --ab, lanes "
+                        "become spec off vs on")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="max draft tokens per verify step")
+    p.add_argument("--spec-draft-layers", type=int, default=1,
+                   help="target layers sliced into the draft model "
+                        "(--spec draft)")
+    p.add_argument("--motif-len", type=int, default=6,
+                   help="repetitive workload: repeated-motif period")
     p.add_argument("--trace", default=None, metavar="FILE",
                    help="replay a recorded JSONL trace instead of a "
                         "synthetic workload (see module docstring)")
@@ -284,6 +321,28 @@ def main(argv=None) -> int:
             ))
         return trace
 
+    def repetitive_trace():
+        """Prompts that loop a short motif. A tiny greedy model locks
+        onto the periodicity almost immediately, so the n-gram drafter's
+        prompt lookup predicts whole windows — the best case speculative
+        decoding is benchmarked against (spec-off A lane shows the same
+        stream one token per dispatch)."""
+        rs = np.random.RandomState(args.seed)
+        trace = []
+        for i in range(args.requests):
+            plen = int(rs.randint(plo, phi + 1))
+            period = max(2, min(args.motif_len, plen))
+            motif = rs.randint(1, args.vocab, size=period).tolist()
+            prompt = (motif * (plen // period + 1))[:plen]
+            trace.append(Request(
+                rid=i,
+                prompt=[int(t) for t in prompt],
+                max_new_tokens=args.max_new,
+                sampling=SamplingParams(temperature=0.0, seed=100 + i),
+                arrival_time=0.0,
+            ))
+        return trace
+
     if args.trace:
         def make_trace():
             return _load_trace_file(
@@ -295,17 +354,27 @@ def main(argv=None) -> int:
     else:
         make_trace = {"uniform": uniform_trace,
                       "adversarial": adversarial_trace,
-                      "shared_prefix": shared_prefix_trace}[args.workload]
+                      "shared_prefix": shared_prefix_trace,
+                      "repetitive": repetitive_trace}[args.workload]
         workload = args.workload
 
+    draft_params = draft_config = None
+    if args.spec == "draft":
+        from tpu_trainer.serving import draft_from_target
+
+        draft_params, draft_config = draft_from_target(
+            params, cfg, args.spec_draft_layers)
+
     def run_lane(lane, prefill_chunk, prefix_cache, trace_fn=make_trace,
-                 wl=None):
+                 wl=None, spec="off"):
         engine = ServingEngine(
             params, cfg, max_batch=args.concurrency,
             block_size=args.block_size, num_blocks=args.num_blocks or None,
             kv_int8=args.kv_int8, attention=args.attention,
             prefill_chunk_tokens=prefill_chunk or None,
             prefix_cache=prefix_cache,
+            spec=spec, spec_k=args.spec_k,
+            draft_params=draft_params, draft_config=draft_config,
         )
         engine.run(trace_fn())        # warm-up: compiles every step shape
         engine.reset_stats()
@@ -343,33 +412,50 @@ def main(argv=None) -> int:
             "prefix_hit_rate": round(summary["prefix_hit_rate"], 4),
             "prefix_evictions": int(summary["prefix_evictions"]),
         }
+        if spec != "off":
+            record.update({
+                "spec": spec,
+                "spec_k": args.spec_k,
+                "spec_steps": int(summary["spec_steps"]),
+                "spec_drafted": int(summary["spec_drafted"]),
+                "spec_accepted": int(summary["spec_accepted"]),
+                "spec_accept_mean": round(summary["spec_accept_mean"], 4),
+                "spec_accept_rate": round(summary["spec_accept_rate"], 4),
+                "spec_accept_hist": summary["spec_accept_hist"],
+            })
         for name, series in lat.items():
             if series:
                 record[f"{name}_p50_s"] = round(
                     float(np.percentile(series, 50)), 5)
                 record[f"{name}_p99_s"] = round(
                     float(np.percentile(series, 99)), 5)
-        return record, drained
+        return record, drained, finished
 
     # --- lanes --------------------------------------------------------------
-    if args.ab:
+    if args.ab and args.spec != "off":
+        # Speculative A/B: same workload/settings, proposer off vs on.
+        lanes = [("spec_off", args.prefill_chunk, args.prefix_cache, "off"),
+                 ("spec_on", args.prefill_chunk, args.prefix_cache,
+                  args.spec)]
+    elif args.ab:
         # Chunk default: big enough that per-iteration dispatch overhead
         # amortizes (short prompts stay single-chunk → tok/s parity with
         # the unchunked lane), small enough that a long prompt still
         # splits into several chunks with decodes interleaved between.
         chunk = args.prefill_chunk or 8 * args.block_size
         if args.workload == "shared_prefix" and not args.trace:
-            lanes = [("no_prefix", args.prefill_chunk, False),
-                     ("prefix", args.prefill_chunk, True)]
+            lanes = [("no_prefix", args.prefill_chunk, False, "off"),
+                     ("prefix", args.prefill_chunk, True, "off")]
         else:
-            lanes = [("unchunked", 0, args.prefix_cache),
-                     ("chunked", chunk, args.prefix_cache)]
+            lanes = [("unchunked", 0, args.prefix_cache, "off"),
+                     ("chunked", chunk, args.prefix_cache, "off")]
     else:
-        lanes = [("serve", args.prefill_chunk, args.prefix_cache)]
+        lanes = [("serve", args.prefill_chunk, args.prefix_cache,
+                  args.spec)]
 
     records, all_drained = [], True
-    for lane, chunk, pfx in lanes:
-        record, drained = run_lane(lane, chunk, pfx)
+    for lane, chunk, pfx, spec in lanes:
+        record, drained, _ = run_lane(lane, chunk, pfx, spec=spec)
         all_drained = all_drained and drained
         records.append(record)
         _print_record(record)
@@ -421,6 +507,9 @@ def main(argv=None) -> int:
                      f"better")
         if b["prefix_cache"] and not a["prefix_cache"]:
             line += f", prefix hit rate {b['prefix_hit_rate']:.2f}"
+        if b.get("spec", "off") != "off":
+            line += (f", {b['spec_accept_mean']:.2f} accepted drafts/step "
+                     f"(rate {b['spec_accept_rate']:.2f})")
         print(line, flush=True)
         if args.update_md:
             update_serving_md(workload, records)
@@ -448,7 +537,7 @@ def main(argv=None) -> int:
         # The long-prompt adversarial case: two near-max prompts land
         # mid-decode with chunked prefill + prefix cache on — the exact
         # configuration the fast path exists for — gated on p99 TPOT.
-        adv_record, adv_drained = run_lane(
+        adv_record, adv_drained, _ = run_lane(
             "smoke_adversarial", args.block_size, True,
             trace_fn=adversarial_trace, wl="adversarial")
         _print_record(adv_record)
@@ -462,6 +551,58 @@ def main(argv=None) -> int:
         if p99 is None or p99 > args.tpot_p99_gate:
             failures.append(
                 f"adversarial p99 TPOT {p99}s > gate {args.tpot_p99_gate}s")
+
+        # Speculative-decode case: the repetitive workload with the
+        # n-gram drafter, gated on (a) greedy bit-parity with the
+        # spec-off stream and (b) drafts actually landing.
+        off_rec, off_drained, off_fin = run_lane(
+            "smoke_spec_off", 0, False,
+            trace_fn=repetitive_trace, wl="repetitive")
+        spec_rec, spec_drained, spec_fin = run_lane(
+            "smoke_spec", 0, False,
+            trace_fn=repetitive_trace, wl="repetitive", spec="ngram")
+        for rec in (off_rec, spec_rec):
+            _print_record(rec)
+            print(json.dumps(rec), flush=True)
+            if args.out:
+                with open(args.out, "a") as fh:
+                    fh.write(json.dumps(rec) + "\n")
+        if not (off_drained and spec_drained):
+            failures.append("repetitive spec trace did not drain")
+        if ([r.generated for r in spec_fin]
+                != [r.generated for r in off_fin]):
+            failures.append(
+                "speculative greedy streams diverge from spec-off")
+        if spec_rec["spec_accept_mean"] < 0.5:
+            failures.append(
+                f"spec accept mean {spec_rec['spec_accept_mean']} < 0.5 "
+                f"on the repetitive workload")
+
+        # Real-recording replay: the checked-in byte-tokenizer trace
+        # (infer.py --serve --record_trace) replays its true token ids
+        # (byte ids < 256 fit the smoke vocab) — gated on drain.
+        byte_trace = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "traces", "byte_trace.jsonl")
+        if os.path.exists(byte_trace):
+            def byte_trace_fn():
+                return _load_trace_file(
+                    byte_trace, vocab_size=args.vocab,
+                    max_seq_len=args.max_seq_len,
+                    default_max_new=args.max_new, seed=args.seed,
+                    Request=Request, SamplingParams=SamplingParams, np=np)
+            bt_rec, bt_drained, _ = run_lane(
+                "smoke_byte_trace", 0, False, trace_fn=byte_trace_fn,
+                wl="trace:byte_trace.jsonl", spec="ngram")
+            _print_record(bt_rec)
+            print(json.dumps(bt_rec), flush=True)
+            if args.out:
+                with open(args.out, "a") as fh:
+                    fh.write(json.dumps(bt_rec) + "\n")
+            if not bt_drained:
+                failures.append("byte trace did not drain")
+        else:
+            failures.append(f"missing checked-in trace {byte_trace}")
 
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
@@ -489,6 +630,13 @@ def _print_record(record) -> None:
           f"prefix hit rate {record['prefix_hit_rate']:.2f} "
           f"({record['prefix_hit_tokens']}/{record['prompt_tokens']} "
           f"prompt tokens)", flush=True)
+    if record.get("spec", "off") != "off":
+        print(f"spec    {record['spec']} k={record['spec_k']}: "
+              f"{record['spec_accept_mean']:.2f} accepted drafts/step "
+              f"(rate {record['spec_accept_rate']:.2f}, "
+              f"{record['spec_accepted']}/{record['spec_drafted']} over "
+              f"{record['spec_steps']} verify steps) "
+              f"hist {record['spec_accept_hist']}", flush=True)
 
 
 def update_serving_md(workload, records) -> None:
@@ -497,22 +645,39 @@ def update_serving_md(workload, records) -> None:
     start = f"<!-- serving-{workload}:start -->"
     end = f"<!-- serving-{workload}:end -->"
     m = records[0]["model"]
+    spec_flag = ""
+    for r in records:
+        if r.get("spec", "off") != "off":
+            spec_flag = f" --spec {r['spec']} --spec-k {r['spec_k']}"
     header = (
-        f"`python benchmarks/serve_bench.py --workload {workload} --ab` — "
+        f"`python benchmarks/serve_bench.py --workload {workload}"
+        f"{spec_flag} --ab` — "
         f"hidden {m['hidden']}, layers {m['layers']}, "
         f"{records[0]['n_requests']} reqs @ concurrency "
         f"{records[0]['concurrency']}, block {records[0]['block_size']} "
         f"({time.strftime('%Y-%m-%d')}).\n\n"
     )
+    spec_ab = any(r.get("spec", "off") != "off" for r in records)
     lines = [
+        "| Lane | chunk | prefix | spec | acc/step | tok/s "
+        "| TTFT p99 (ms) | TPOT p99 (ms) | hit rate | preemptions |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ] if spec_ab else [
         "| Lane | chunk | prefix | tok/s | TTFT p99 (ms) | TPOT p99 (ms) "
         "| hit rate | preemptions |",
         "|---|---|---|---|---|---|---|---|",
     ]
     for r in records:
+        spec_cols = ""
+        if spec_ab:
+            spec_cols = (
+                f"| {r.get('spec', 'off')} "
+                f"| {r['spec_accept_mean']:.2f} "
+                if r.get("spec", "off") != "off" else "| off | - ")
         lines.append(
             f"| {r['lane']} | {r['prefill_chunk'] or '-'} "
             f"| {'on' if r['prefix_cache'] else 'off'} "
+            f"{spec_cols}"
             f"| {r['tokens_per_s']:,.0f} "
             f"| {(r.get('ttft_p99_s') or 0) * 1e3:.1f} "
             f"| {(r.get('tpot_p99_s') or 0) * 1e3:.1f} "

@@ -592,3 +592,79 @@ class TestRouterSection:
         assert analyze.main([capacity, "--compare", base]) == 0
         plain = _write(tmp_path / "p.jsonl", _run_records())
         assert analyze.main([plain, "--compare", base]) == 0
+
+
+class TestSpecGate:
+    """Speculative-decoding serve report + the acceptance-floor gate
+    (ISSUE 13)."""
+
+    @staticmethod
+    def _serve_record(spec="ngram", accept_mean=3.5):
+        rec = {"kind": "serve", "schema_version": SCHEMA_VERSION,
+               "lane": "spec_on", "tok_per_sec": 600.0, "spec": spec}
+        if spec != "off":
+            rec.update({"spec_k": 4, "spec_steps": 100, "spec_drafted": 400,
+                        "spec_accepted": int(accept_mean * 100),
+                        "spec_accept_mean": accept_mean,
+                        "spec_accept_rate": accept_mean / 4.0,
+                        "spec_accept_hist": [10, 20, 30, 40]})
+        return rec
+
+    def test_summarize_and_render_spec(self, tmp_path):
+        report = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "run.jsonl",
+            _run_records() + [self._serve_record()])))
+        sv = report["serve"]
+        assert sv["spec"] == "ngram"
+        assert sv["spec_accept_mean"] == pytest.approx(3.5)
+        text = "\n".join(analyze.render(report))
+        assert "accepted drafts/step" in text
+
+    def test_gate_passes_over_floor_and_fails_under(self, tmp_path):
+        base = _write(tmp_path / "b.jsonl",
+                      _run_records() + [self._serve_record()])
+        good = _write(tmp_path / "g.jsonl",
+                      _run_records() + [self._serve_record(accept_mean=2.0)])
+        assert analyze.main([good, "--compare", base,
+                             "--spec-accept-tol", "1.0"]) == 0
+        bad = _write(tmp_path / "f.jsonl",
+                     _run_records() + [self._serve_record(accept_mean=0.4)])
+        assert analyze.main([bad, "--compare", base,
+                             "--spec-accept-tol", "1.0"]) == 1
+
+    def test_gate_is_absolute_with_plain_tolerance(self, tmp_path):
+        # Even an acceptance IMPROVEMENT over base fails a floor it does
+        # not clear — the gate reads only the new run.
+        base = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "b.jsonl",
+            _run_records() + [self._serve_record(accept_mean=0.2)])))
+        new = analyze.summarize(analyze.load_records(_write(
+            tmp_path / "n.jsonl",
+            _run_records() + [self._serve_record(accept_mean=0.5)])))
+        verdicts = {v["metric"]: v for v in analyze.compare(
+            base, new, spec_accept_tol=1.0)}
+        v = verdicts["spec_accept_mean"]
+        assert v["verdict"] == "FAIL" and v["absolute"] is True
+        assert v["tolerance"] == 1.0
+        lines = analyze.render_verdicts([v])
+        assert any("floor 1.00 abs" in l for l in lines)
+
+    def test_gate_skips_non_spec_runs(self, tmp_path):
+        # spec-off serve runs and serve-less runs both SKIP, even under
+        # a floor that would fail any spec run.
+        base = _write(tmp_path / "b.jsonl",
+                      _run_records() + [self._serve_record()])
+        off = _write(tmp_path / "o.jsonl",
+                     _run_records() + [self._serve_record(spec="off")])
+        assert analyze.main([off, "--compare", base,
+                             "--spec-accept-tol", "99.0"]) == 0
+        plain = _write(tmp_path / "p.jsonl", _run_records())
+        assert analyze.main([plain, "--compare", base,
+                             "--spec-accept-tol", "99.0"]) == 0
+
+    def test_default_floor_always_passes(self, tmp_path):
+        base = _write(tmp_path / "b.jsonl",
+                      _run_records() + [self._serve_record()])
+        weak = _write(tmp_path / "w.jsonl",
+                      _run_records() + [self._serve_record(accept_mean=0.0)])
+        assert analyze.main([weak, "--compare", base]) == 0

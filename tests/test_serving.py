@@ -210,7 +210,7 @@ class TestSampling:
         logits = jnp.asarray(np.random.RandomState(1)
                              .standard_normal((3, 16)).astype(np.float32))
         toks = sample_tokens(
-            logits, jnp.zeros(3), jnp.zeros(3, jnp.int32),
+            logits, jnp.zeros(3), jnp.zeros(3, jnp.int32), jnp.ones(3),
             jnp.asarray(np.stack([request_key(s) for s in (1, 2, 3)])),
             jnp.zeros(3, jnp.int32), k_cap=4)
         np.testing.assert_array_equal(
@@ -232,7 +232,7 @@ class TestSampling:
             keys = np.tile(request_key(0), (b, 1))
             keys[pos] = key
             toks = sample_tokens(jnp.asarray(lg), temps, ks,
-                                 jnp.asarray(keys),
+                                 jnp.ones((b,)), jnp.asarray(keys),
                                  jnp.full((b,), 3, jnp.int32), k_cap=k_cap)
             return int(toks[pos])
 
@@ -240,6 +240,62 @@ class TestSampling:
         crowded = draw(np.concatenate(
             [rs.standard_normal((3, 40)).astype(np.float32), row]), 3,
             k_cap=50)
+        assert alone == crowded
+
+
+class TestTopP:
+    def test_sampling_params_validation(self):
+        for bad in (dict(top_p=0.0), dict(top_p=-0.1), dict(top_p=1.5),
+                    dict(temperature=-1.0), dict(top_k=-1)):
+            with pytest.raises(ValueError):
+                SamplingParams(**bad)
+        SamplingParams(top_p=1.0)   # boundary is legal
+        SamplingParams(top_p=0.5, temperature=0.0, top_k=0)
+
+    def test_nucleus_support(self):
+        # p = [0.5, 0.3, 0.2]: a 0.6 budget keeps {0, 1} (token 1 is the
+        # boundary token and boundary tokens are kept), never token 2.
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.2]]))
+        drawn = set()
+        for step in range(64):
+            toks = sample_tokens(
+                logits, jnp.ones(1), jnp.zeros(1, jnp.int32),
+                jnp.full((1,), 0.6), jnp.asarray([request_key(7)]),
+                jnp.full((1,), step, jnp.int32), k_cap=1)
+            drawn.add(int(toks[0]))
+        assert drawn == {0, 1}
+
+    def test_top_p_one_is_identity(self):
+        from tpu_trainer.serving.sampling import filter_logits
+        logits = jnp.asarray(np.random.RandomState(3)
+                             .standard_normal((4, 19)).astype(np.float32))
+        temps = jnp.asarray([0.0, 0.5, 1.0, 2.0])
+        ks = jnp.asarray([0, 3, 0, 5], jnp.int32)
+        full = filter_logits(logits, temps, ks, jnp.ones(4), k_cap=8)
+        expect = jnp.where(
+            jnp.isneginf(full), -jnp.inf,
+            logits / jnp.where(temps > 0, temps, 1.0)[:, None])
+        np.testing.assert_array_equal(np.asarray(full), np.asarray(expect))
+
+    def test_top_p_batch_invariant(self):
+        rs = np.random.RandomState(4)
+        row = rs.standard_normal((1, 40)).astype(np.float32)
+        key = request_key(11)
+
+        def draw(batch_rows, pos):
+            lg = jnp.asarray(batch_rows)
+            b = lg.shape[0]
+            keys = np.tile(request_key(0), (b, 1))
+            keys[pos] = key
+            toks = sample_tokens(
+                lg, jnp.full((b,), 0.8), jnp.zeros((b,), jnp.int32),
+                jnp.full((b,), 0.7), jnp.asarray(keys),
+                jnp.full((b,), 2, jnp.int32), k_cap=1)
+            return int(toks[pos])
+
+        alone = draw(row, 0)
+        crowded = draw(np.concatenate(
+            [rs.standard_normal((3, 40)).astype(np.float32), row]), 3)
         assert alone == crowded
 
 

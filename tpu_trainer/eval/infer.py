@@ -63,6 +63,18 @@ def main(argv=None) -> int:
                    help="serving engine slot batch (with --serve)")
     p.add_argument("--serve_block_size", type=int, default=16,
                    help="paged KV cache block size (with --serve)")
+    p.add_argument("--spec", default="off",
+                   choices=["off", "ngram", "draft"],
+                   help="speculative decoding proposer (with --serve); "
+                        "greedy output is bit-identical either way")
+    p.add_argument("--spec_k", type=int, default=4,
+                   help="max draft tokens per verify step (with --spec)")
+    p.add_argument("--spec_draft_layers", type=int, default=1,
+                   help="checkpoint layers sliced into the draft model "
+                        "(with --spec draft)")
+    p.add_argument("--record_trace", default=None, metavar="OUT.JSONL",
+                   help="append each served prompt/response as a "
+                        "serve_bench-replayable trace record (with --serve)")
     p.add_argument("--mesh_data", type=int, default=1,
                    help="shard batch rows over a data mesh axis")
     p.add_argument("--mesh_tensor", type=int, default=1,
@@ -128,6 +140,11 @@ def main(argv=None) -> int:
         p.error("ragged multi-prompt decode needs the KV path: shorten "
                 "--max_new_tokens to fit max_seq_len, or drop --no_kv_cache")
 
+    if args.record_trace and not args.serve:
+        p.error("--record_trace records served requests; add --serve")
+    if args.spec != "off" and not args.serve:
+        p.error("--spec is a serving-engine feature; add --serve")
+
     if args.serve:
         # Serving-engine escape hatch: same checkpoint/tokenizer plumbing,
         # but each prompt is an independent request with its own sampling
@@ -141,12 +158,23 @@ def main(argv=None) -> int:
             p.error("--serve does not compose with mesh sharding yet")
         if not fits:
             p.error("prompt + --max_new_tokens exceeds max_seq_len")
-        from tpu_trainer.serving import Request, SamplingParams, ServingEngine
+        from tpu_trainer.serving import (
+            Request, SamplingParams, ServingEngine, draft_from_target,
+        )
 
+        draft_params = draft_config = None
+        if args.spec == "draft":
+            if args.spec_draft_layers >= config.num_layers:
+                p.error(f"--spec_draft_layers {args.spec_draft_layers} must "
+                        f"be < the checkpoint's {config.num_layers} layers")
+            draft_params, draft_config = draft_from_target(
+                params, config, args.spec_draft_layers)
         engine = ServingEngine(
             params, config,
             max_batch=min(len(rows), args.serve_batch),
             block_size=args.serve_block_size,
+            spec=args.spec, spec_k=args.spec_k,
+            draft_params=draft_params, draft_config=draft_config,
         )
         reqs = [
             Request(rid=i, prompt=list(r),
@@ -156,8 +184,32 @@ def main(argv=None) -> int:
                                             seed=args.seed + i))
             for i, r in enumerate(rows)
         ]
-        for r in engine.run(reqs, time_mode="steps"):
+        finished = engine.run(reqs, time_mode="steps")
+        for r in finished:
             print(tokenizer.decode(r.prompt + r.generated))
+        if args.record_trace:
+            # Replayable serve_bench records (benchmarks/serve_bench.py
+            # --trace): real token ids ride along in prompt_tokens so a
+            # replay model with a covering vocab feeds the true prompt;
+            # loaders without them fall back to seeded synthesis at the
+            # same lengths. Text fields are provenance, ignored on load.
+            import json as _json
+
+            with open(args.record_trace, "a") as fh:
+                for i, r in enumerate(finished):
+                    fh.write(_json.dumps({
+                        "prompt_len": len(r.prompt),
+                        "max_new": r.max_new_tokens,
+                        "arrival_time": r.arrival_time,
+                        "temperature": r.sampling.temperature,
+                        "top_k": r.sampling.top_k,
+                        "top_p": r.sampling.top_p,
+                        "seed": r.sampling.seed,
+                        "prompt_tokens": [int(t) for t in r.prompt],
+                        "tokenizer": tokenizer.name,
+                        "prompt_text": prompts[i],
+                        "response_text": tokenizer.decode(r.generated),
+                    }) + "\n")
         return 0
 
     n_shards = args.mesh_data * args.mesh_tensor

@@ -393,6 +393,14 @@ class CausalSelfAttention(nn.Module):
           same order the monolithic pass reduces in, which is what keeps
           chunked greedy streams bit-identical. ``lengths`` is left
           as-is (it already counts the tokens deposited so far).
+          The speculative-decode verifier (serving/spec.py) rides this
+          exact branch: it feeds [last accepted token + K drafts] as a
+          chunk at ``offsets[r] = cached_tokens`` and consumes the
+          model's per-position logits for the whole window (the model
+          always returns ``[b, s, vocab]``; slicing to the last position
+          is the caller's choice), scoring all K+1 candidates in one
+          forward. Rejected positions' pool writes are harmless: the
+          host rewinds ``lengths`` and every read masks by it.
         - decode (``s == 1``): the new token writes at position
           ``lengths[r]`` of row r's table and attends over ``lengths[r]
           + 1`` pooled positions (flash_decode kernel or the jnp
@@ -477,6 +485,17 @@ class CausalSelfAttention(nn.Module):
             # ragged path above. With offsets == 0 this is exactly the
             # original whole-prompt mask.
             kf, vf = k, v
+            if int8:
+                # Attend the quantization the pool will actually hold:
+                # a later decode step reads these positions back through
+                # the int8 round-trip, so a multi-token window (chunked
+                # prefill, speculative verify) must see the same values
+                # now — otherwise a token scored here and a token scored
+                # by the one-at-a-time path diverge under int8.
+                from tpu_trainer.utils.quant import dequantize_kv_int8
+
+                kf = dequantize_kv_int8(k_q, k_s, q.dtype)
+                vf = dequantize_kv_int8(v_q, v_s, q.dtype)
             if kvh != h:
                 from tpu_trainer.ops.attention import repeat_kv
 

@@ -14,6 +14,10 @@ a *request stream* —
 - ``sampling``     — batched per-request sampling (temperature / top-k /
   seed), deterministic per (seed, token index) so preempted requests
   resume with identical continuations.
+- ``spec``         — speculative decoding: model-free n-gram or small
+  draft-model proposers drafting K tokens that ONE target forward
+  verifies over the paged cache (write-ahead + host rewind), greedy
+  streams bit-identical to non-speculative decode.
 - ``engine``       — the front-end: jitted prefill/decode steps over the
   paged model path (``GPTConfig.decode_paged``), latency/throughput
   stats, and a ``python -m tpu_trainer.serving.engine`` CLI replaying a
@@ -26,4 +30,11 @@ from tpu_trainer.serving.scheduler import (  # noqa: F401
     Request,
     SamplingParams,
     Scheduler,
+)
+from tpu_trainer.serving.spec import (  # noqa: F401
+    AdaptiveK,
+    DraftModelProposer,
+    NGramProposer,
+    SpecDecoder,
+    draft_from_target,
 )

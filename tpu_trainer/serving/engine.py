@@ -56,6 +56,13 @@ from tpu_trainer.models.gpt import GPT, init_paged_cache
 from tpu_trainer.serving.paged_cache import PagedKVCache
 from tpu_trainer.serving.sampling import sample_tokens
 from tpu_trainer.serving.scheduler import Request, SamplingParams, Scheduler
+from tpu_trainer.serving.spec import (
+    DraftModelProposer,
+    NGramProposer,
+    SpecDecoder,
+    _verify_step,
+    draft_from_target,
+)
 
 
 def _bucket_pow2(n: int, lo: int = 8) -> int:
@@ -83,8 +90,17 @@ class ServingEngine:
         watermark_blocks: int = 0,
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache: bool = False,
+        spec: str = "off",
+        spec_k: int = 4,
+        spec_adaptive: bool = True,
+        spec_ngram_max: int = 3,
+        draft_params=None,
+        draft_config: Optional[GPTConfig] = None,
+        spec_proposer=None,
         clock=time.perf_counter,
     ):
+        if spec not in ("off", "ngram", "draft"):
+            raise ValueError(f"spec={spec!r} (off | ngram | draft)")
         if max_blocks_per_request is None:
             max_blocks_per_request = -(-config.max_seq_len // block_size)
         if num_blocks is None:
@@ -110,16 +126,36 @@ class ServingEngine:
         self.cache_state = PagedKVCache(
             self.config, max_batch, prefix_cache=prefix_cache
         )
+        # Speculative decoding: resolve the proposer before the
+        # scheduler so admission can budget for the draft window.
+        proposer = spec_proposer
+        if proposer is None and spec == "ngram":
+            proposer = NGramProposer(max_ngram=spec_ngram_max)
+        elif proposer is None and spec == "draft":
+            if draft_params is None or draft_config is None:
+                raise ValueError(
+                    "spec='draft' needs draft_params and draft_config "
+                    "(see spec.draft_from_target)")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError("draft/target vocab mismatch")
+            if draft_config.max_seq_len < config.max_seq_len:
+                raise ValueError("draft max_seq_len < target max_seq_len")
+            proposer = DraftModelProposer(
+                draft_params, draft_config, slots=max_batch,
+                block_size=block_size, attention=attention)
+        self.spec_decoder = (
+            SpecDecoder(proposer, k=spec_k, adaptive=spec_adaptive)
+            if proposer is not None else None)
         self.scheduler = Scheduler(
             self.cache_state, watermark_blocks=watermark_blocks,
             prefill_chunk_tokens=prefill_chunk_tokens,
+            spec_reserve_tokens=(
+                spec_k + 1 if self.spec_decoder is not None else 0),
         )
         self.device_cache = init_paged_cache(self.config, max_batch)
         self._model = GPT(self.config)
-        self._step_jit = jax.jit(
-            functools.partial(_engine_step, self.config),
-            static_argnames=("k_cap", "prefill", "hist_blocks"),
-        )
+        self._step_jit = _jitted_engine_step(self.config)
+        self._verify_jit = _jitted_verify_step(self.config)
         self._k_cap = 1
         self._iters = 0
         self._t0 = None
@@ -129,6 +165,7 @@ class ServingEngine:
             "generated_tokens": 0,
             "occupancy_sum": 0.0, "occupancy_samples": 0,
             "occupancy_max": 0.0,
+            "spec_steps": 0, "spec_drafted": 0, "spec_accepted": 0,
         }
 
     def reset_stats(self) -> None:
@@ -143,6 +180,8 @@ class ServingEngine:
         self.scheduler.prompt_tokens = 0
         self.cache_state.n_prefix_evictions = 0
         self.wall_elapsed = 0.0
+        if self.spec_decoder is not None:
+            self.spec_decoder.reset_stats()
         for k in self.stats:
             self.stats[k] = 0.0 if isinstance(self.stats[k], float) else 0
 
@@ -158,6 +197,9 @@ class ServingEngine:
         if kind == "prefill":
             finished = self._forward(reqs, prefill=True)
             self.stats["prefill_iters"] += 1
+        elif self.spec_decoder is not None:
+            finished = self._spec_decode()
+            self.stats["decode_iters"] += 1
         else:
             reqs = self.scheduler.ensure_decode_blocks()
             if not reqs:          # everything preempted itself back out
@@ -212,11 +254,13 @@ class ServingEngine:
                 lengths[r.slot] = r.cached_tokens()
         temps = np.zeros((slots,), np.float32)
         topks = np.zeros((slots,), np.int32)
+        topps = np.ones((slots,), np.float32)
         keys = np.zeros((slots, 2), np.uint32)
         steps = np.zeros((slots,), np.int32)
         for r in reqs:
             temps[r.slot] = r.sampling.temperature
             topks[r.slot] = r.sampling.top_k
+            topps[r.slot] = r.sampling.top_p
             keys[r.slot] = r.key()
             steps[r.slot] = len(r.generated)   # index of the draw made now
             if r.sampling.top_k > self._k_cap:
@@ -226,7 +270,8 @@ class ServingEngine:
             self.params, self.device_cache,
             jnp.asarray(tables), jnp.asarray(lengths),
             jnp.asarray(offsets), jnp.asarray(ids),
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(keys),
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+            jnp.asarray(keys),
             jnp.asarray(steps), k_cap=self._k_cap, prefill=prefill,
             hist_blocks=hist_blocks,
         )
@@ -259,6 +304,117 @@ class ServingEngine:
                 r.finished_at = now
                 self.scheduler.retire(r)
                 finished.append(r)
+        return finished
+
+    def _spec_decode(self) -> List[Request]:
+        """One speculative decode iteration: propose per-request drafts,
+        pre-grow blocks for the worst-case window, verify all K+1
+        positions in ONE target forward (the chunked-prefill branch at
+        each row's cached offset), then emit the accepted prefix plus
+        the target's correction/bonus token and rewind — host lengths
+        roll back to the accept point and trailing blocks return to the
+        pool the same iteration. Greedy rows emit the target argmax
+        chain, so their streams bit-match non-speculative decode."""
+        sd = self.spec_decoder
+        cs = self.cache_state
+        reqs = [r for r in self.scheduler.running
+                if r.status == "running" and not r.prefilling()]
+        if not reqs:
+            return []
+        drafts = sd.propose(reqs)
+        window = {r.rid: len(drafts.get(r.rid, [])) + 1 for r in reqs}
+        if all(n == 1 for n in window.values()):
+            # Nothing drafted anywhere: plain single-token decode.
+            reqs = self.scheduler.ensure_decode_blocks()
+            if not reqs:
+                return []
+            return self._forward(reqs, prefill=False)
+        reqs = self.scheduler.ensure_spec_blocks(reqs, window)
+        if not reqs:              # everything preempted itself back out
+            return []
+        max_m = max(window[r.rid] - 1 for r in reqs)
+        if max_m == 0:            # the drafted rows were all preempted
+            return self._forward(reqs, prefill=False)
+
+        slots = self.max_batch
+        width = min(_bucket_pow2(max_m + 1, lo=2), cs.capacity_tokens())
+        tables = np.zeros_like(cs.tables)
+        lengths = np.zeros((slots,), np.int32)
+        offsets = np.zeros((slots,), np.int32)
+        ids = np.zeros((slots, width), np.int32)
+        dlens = np.zeros((slots,), np.int32)
+        temps = np.zeros((slots,), np.float32)
+        topks = np.zeros((slots,), np.int32)
+        topps = np.ones((slots,), np.float32)
+        keys = np.zeros((slots, 2), np.uint32)
+        steps = np.zeros((slots,), np.int32)
+        max_off = 0
+        for r in reqs:
+            d = drafts.get(r.rid, [])
+            cached = r.cached_tokens()
+            seq = r.prompt + r.generated
+            ids[r.slot, 0] = seq[-1]
+            ids[r.slot, 1:1 + len(d)] = d
+            tables[r.slot] = cs.tables[r.slot]
+            offsets[r.slot] = cached
+            lengths[r.slot] = cached + len(d) + 1
+            dlens[r.slot] = len(d)
+            temps[r.slot] = r.sampling.temperature
+            topks[r.slot] = r.sampling.top_k
+            topps[r.slot] = r.sampling.top_p
+            keys[r.slot] = r.key()
+            steps[r.slot] = len(r.generated)
+            max_off = max(max_off, cached)
+            if r.sampling.top_k > self._k_cap:
+                self._k_cap = r.sampling.top_k
+        # The window rides the chunked-prefill branch: cached context is
+        # the pooled history (cached >= 1 always in decode).
+        hist_blocks = min(
+            _bucket_pow2(cs.blocks_for(max_off), lo=1), cs.max_blocks)
+
+        self.device_cache, emitted, n_acc = self._verify_jit(
+            self.params, self.device_cache,
+            jnp.asarray(tables), jnp.asarray(lengths),
+            jnp.asarray(offsets), jnp.asarray(ids), jnp.asarray(dlens),
+            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+            jnp.asarray(keys), jnp.asarray(steps),
+            k_cap=self._k_cap, hist_blocks=hist_blocks,
+        )
+        emitted = np.asarray(emitted)
+        n_acc = np.asarray(n_acc)
+
+        now = self._now()
+        finished: List[Request] = []
+        for r in reqs:
+            m = int(dlens[r.slot])
+            j = int(n_acc[r.slot])
+            sd.observe(r, m, j)
+            self.stats["spec_steps"] += 1
+            self.stats["spec_drafted"] += m
+            self.stats["spec_accepted"] += j
+            done = False
+            for tok in emitted[r.slot, :j + 1]:
+                tok = int(tok)
+                r.generated.append(tok)
+                r.token_times.append(now)
+                self.stats["generated_tokens"] += 1
+                if r.first_token_at is None:
+                    r.first_token_at = now
+                if (r.eos_id is not None and tok == r.eos_id) or (
+                    len(r.generated) >= r.max_new_tokens
+                ):
+                    done = True
+                    break     # tokens past EOS are never emitted
+            # Host rewind: cache holds everything up to the accept point
+            # (write-ahead past it is masked garbage the shrink reclaims).
+            cs.lengths[r.slot] = r.context_len() - 1
+            if done:
+                r.finished_at = now
+                sd.forget(r)
+                self.scheduler.retire(r)
+                finished.append(r)
+            else:
+                self.scheduler.shrink_spec_blocks(r)
         return finished
 
     def _register_prefix_blocks(self, r: Request) -> None:
@@ -337,6 +493,15 @@ class ServingEngine:
             / max(1, self.scheduler.prompt_tokens)
         )
         s["prefix_evictions"] = self.cache_state.n_prefix_evictions
+        if self.spec_decoder is not None:
+            s["spec_accept_mean"] = (
+                s["spec_accepted"] / max(1, int(s["spec_steps"])))
+            s["spec_accept_rate"] = (
+                s["spec_accepted"] / max(1, int(s["spec_drafted"])))
+            s["spec_accept_hist"] = list(self.spec_decoder.accept_hist)
+        else:
+            for k in ("spec_steps", "spec_drafted", "spec_accepted"):
+                s.pop(k)
         if getattr(self, "wall_elapsed", 0):
             s["wall_s"] = self.wall_elapsed
             s["tokens_per_s"] = s["generated_tokens"] / self.wall_elapsed
@@ -345,7 +510,7 @@ class ServingEngine:
 
 def _engine_step(
     config, params, cache, tables, lengths, offsets, ids,
-    temps, topks, keys, steps, *, k_cap: int, prefill: bool,
+    temps, topks, topps, keys, steps, *, k_cap: int, prefill: bool,
     hist_blocks: int,
 ) -> Tuple[dict, jax.Array]:
     """One jitted engine step: broadcast host scheduling state into the
@@ -378,9 +543,32 @@ def _engine_step(
     else:
         last = logits[:, 0]
     tokens = sample_tokens(
-        last.astype(jnp.float32), temps, topks, keys, steps, k_cap=k_cap
+        last.astype(jnp.float32), temps, topks, topps, keys, steps,
+        k_cap=k_cap,
     )
     return vars_out["cache"], tokens
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_engine_step(config):
+    """Per-config memo of the jitted step. ``GPTConfig`` is frozen, so
+    engines built with equal configs get the SAME jit object — and with
+    it the same compile cache. Constructing a second identically-shaped
+    engine (warm-up/timed pairs, A/B lanes, test matrices, the draft
+    proposer reusing the target's step) then costs zero retraces."""
+    return jax.jit(
+        functools.partial(_engine_step, config),
+        static_argnames=("k_cap", "prefill", "hist_blocks"),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_verify_step(config):
+    """Same per-config sharing for the speculative verify step."""
+    return jax.jit(
+        functools.partial(_verify_step, config),
+        static_argnames=("k_cap", "hist_blocks"),
+    )
 
 
 def poisson_trace(
@@ -393,6 +581,7 @@ def poisson_trace(
     max_new_range: Tuple[int, int] = (8, 32),
     temperature: float = 1.0,
     top_k: int = 0,
+    top_p: float = 1.0,
     eos_id: Optional[int] = None,
 ) -> List[Request]:
     """Synthetic open-loop trace: exponential inter-arrivals at ``rate``
@@ -411,7 +600,7 @@ def poisson_trace(
             prompt=[int(t) for t in prompt],
             max_new_tokens=mnew,
             sampling=SamplingParams(
-                temperature=temperature, top_k=top_k,
+                temperature=temperature, top_k=top_k, top_p=top_p,
                 seed=int(rs.randint(0, 2**31 - 1)),
             ),
             arrival_time=float(arrivals[i]),
@@ -470,6 +659,16 @@ def _main() -> int:
                    choices=("auto", "reference", "kernel"))
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling mass (1.0 = off)")
+    p.add_argument("--spec", default="off",
+                   choices=("off", "ngram", "draft"),
+                   help="speculative decoding proposer")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="max draft tokens per verify step")
+    p.add_argument("--spec-draft-layers", type=int, default=1,
+                   help="target layers sliced into the draft model "
+                        "(--spec draft)")
     p.add_argument("--time-mode", default="wall", choices=("wall", "steps"))
     p.add_argument("--vocab", type=int, default=512)
     p.add_argument("--hidden", type=int, default=128)
@@ -488,6 +687,10 @@ def _main() -> int:
     params = model.init(
         jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
     )["params"]
+    draft_params = draft_config = None
+    if args.spec == "draft":
+        draft_params, draft_config = draft_from_target(
+            params, config, args.spec_draft_layers)
     engine = ServingEngine(
         params, config, max_batch=args.max_batch,
         block_size=args.block_size,
@@ -495,10 +698,13 @@ def _main() -> int:
         kv_int8=args.kv_int8, attention=args.attention,
         prefill_chunk_tokens=args.prefill_chunk or None,
         prefix_cache=args.prefix_cache,
+        spec=args.spec, spec_k=args.spec_k,
+        draft_params=draft_params, draft_config=draft_config,
     )
     trace = poisson_trace(
         args.requests, vocab_size=args.vocab, rate=args.rate,
         seed=args.seed, temperature=args.temperature, top_k=args.top_k,
+        top_p=args.top_p,
     )
     finished = engine.run(trace, time_mode=args.time_mode)
     summary = engine.summary()

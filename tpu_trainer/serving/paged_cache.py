@@ -164,6 +164,25 @@ class PagedKVCache:
         self.lengths[slot] = 0
         self._n_blocks[slot] = 0
 
+    def shrink(self, slot: int, keep_blocks: int) -> int:
+        """Drop the slot's TRAILING blocks past ``keep_blocks`` — the
+        speculative-decode rejection rewind (serving/spec.py): blocks
+        grown for a draft window whose tokens the verifier rejected go
+        back to the pool the same iteration. Refcount-safe by the same
+        argument as ``release`` (a block shared with the prefix index
+        survives there), though in practice the tail past a request's
+        cached tokens is always private: shared blocks are full PROMPT
+        blocks at the front of the table. Returns blocks freed."""
+        n0 = int(self._n_blocks[slot])
+        if keep_blocks >= n0:
+            return 0
+        assert keep_blocks >= 1, f"shrink(slot={slot}, keep={keep_blocks})"
+        tail = [int(b) for b in self.tables[slot, keep_blocks:n0]]
+        self.pool.free(tail)
+        self.tables[slot, keep_blocks:n0] = 0
+        self._n_blocks[slot] = keep_blocks
+        return len(tail)
+
     # -- prefix index ------------------------------------------------------
 
     def block_digests(self, tokens: List[int]) -> List[bytes]:

@@ -3,16 +3,23 @@
 ``generate_kv`` samples one shared (temperature, top_k) per call;
 continuous batching puts requests with *different* sampling params in
 one decode row-batch. This module samples the whole batch in one jitted
-op with per-row temperature / top-k / PRNG key, and keys every draw by
-``fold_in(request_key, token_index)`` — the stream for a request depends
-only on its own seed and position, NOT on which other requests share the
-batch or how scheduling interleaved them. That independence is what
-makes preemption recompute-safe (a resumed request re-derives the exact
-draws it would have made) and replay deterministic.
+op with per-row temperature / top-k / top-p / PRNG key, and keys every
+draw by ``fold_in(request_key, token_index)`` — the stream for a request
+depends only on its own seed and position, NOT on which other requests
+share the batch or how scheduling interleaved them. That independence is
+what makes preemption recompute-safe (a resumed request re-derives the
+exact draws it would have made) and replay deterministic.
 
 ``temperature == 0`` rows take exact greedy argmax (the same contract as
 the fixed ``models/gpt.py _sample``), here as a data-dependent select
 since temperature is a traced per-row array.
+
+``filter_logits`` is the shared filtering pipeline (top-k at a static
+``k_cap``, nucleus top-p over the temperature-scaled distribution,
+temperature scale) — ``sample_tokens`` draws from it, and the
+speculative-decode verifier (serving/spec.py) reuses it so acceptance
+probabilities and residual draws see exactly the distribution the
+non-speculative sampler would have drawn from.
 """
 
 from __future__ import annotations
@@ -23,20 +30,24 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.partial(jax.jit, static_argnames=("k_cap",))
-def sample_tokens(
+def filter_logits(
     logits: jax.Array,      # [b, vocab] f32
-    temps: jax.Array,       # [b] f32; 0 = greedy
+    temps: jax.Array,       # [b] f32; 0 = greedy (rows pass through)
     top_ks: jax.Array,      # [b] int32; 0 = no top-k filter
-    key_data: jax.Array,    # [b, 2] uint32 per-request PRNG keys
-    steps: jax.Array,       # [b] int32 token index within each request
+    top_ps: jax.Array,      # [b] f32; 1 = no nucleus filter
     *,
     k_cap: int,
 ) -> jax.Array:
-    """One token id per row. ``k_cap`` (static) bounds every row's top_k:
-    one ``lax.top_k(logits, k_cap)`` serves all rows, each masking at its
-    own kth value. The engine derives k_cap from the requests it admits
-    and recompiles only when a larger cap first appears."""
+    """Temperature-scaled logits with top-k then top-p applied per row.
+
+    ``k_cap`` (static) bounds every row's top_k: one ``lax.top_k(logits,
+    k_cap)`` serves all rows, each masking at its own kth value. Nucleus
+    filtering keeps the smallest set of tokens whose cumulative
+    (temperature-scaled) probability reaches ``top_p`` — boundary ties
+    are all kept, and the top token always survives. Rows with ``top_p
+    == 1`` skip the nucleus mask entirely, so pre-top-p streams are
+    reproduced bit-for-bit.
+    """
     b, vocab = logits.shape
     k_cap = max(1, min(k_cap, vocab))
     vals = jax.lax.top_k(logits, k_cap)[0]                 # [b, k_cap] desc
@@ -46,6 +57,33 @@ def sample_tokens(
     filtered = jnp.where(
         (k > 0)[:, None] & (logits < kth), -jnp.inf, logits)
     scaled = filtered / jnp.where(temps > 0, temps, 1.0)[:, None]
+    p_lim = jnp.clip(top_ps, 0.0, 1.0)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    sp = jax.lax.top_k(probs, vocab)[0]                    # [b, vocab] desc
+    csum = jnp.cumsum(sp, axis=-1)
+    # Keep a token when the mass strictly above it is still short of the
+    # budget; the cutoff is the smallest kept probability.
+    keep_n = jnp.maximum(
+        jnp.sum((csum - sp) < p_lim[:, None], axis=-1), 1)
+    cutoff = jnp.take_along_axis(sp, (keep_n - 1)[:, None], axis=1)
+    return jnp.where(
+        (p_lim < 1.0)[:, None] & (probs < cutoff), -jnp.inf, scaled)
+
+
+@functools.partial(jax.jit, static_argnames=("k_cap",))
+def sample_tokens(
+    logits: jax.Array,      # [b, vocab] f32
+    temps: jax.Array,       # [b] f32; 0 = greedy
+    top_ks: jax.Array,      # [b] int32; 0 = no top-k filter
+    top_ps: jax.Array,      # [b] f32; 1 = no nucleus filter
+    key_data: jax.Array,    # [b, 2] uint32 per-request PRNG keys
+    steps: jax.Array,       # [b] int32 token index within each request
+    *,
+    k_cap: int,
+) -> jax.Array:
+    """One token id per row. The engine derives k_cap from the requests
+    it admits and recompiles only when a larger cap first appears."""
+    scaled = filter_logits(logits, temps, top_ks, top_ps, k_cap=k_cap)
     sampled = jax.vmap(
         lambda kd, st, lg: jax.random.categorical(
             jax.random.fold_in(kd, st), lg)

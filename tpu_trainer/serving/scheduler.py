@@ -50,11 +50,23 @@ from tpu_trainer.serving.paged_cache import PagedKVCache
 
 @dataclasses.dataclass
 class SamplingParams:
-    """Per-request sampling knobs (``temperature == 0`` = exact greedy)."""
+    """Per-request sampling knobs (``temperature == 0`` = exact greedy;
+    ``top_p == 1`` = no nucleus filter). Validated at construction —
+    i.e. at ``Request`` build time, before anything reaches the jitted
+    sampler — so a bad knob is a ValueError here, not a NaN inside jit."""
 
     temperature: float = 1.0
     top_k: int = 0
+    top_p: float = 1.0
     seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature {self.temperature} < 0")
+        if self.top_k < 0:
+            raise ValueError(f"top_k {self.top_k} < 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p {self.top_p} outside (0, 1]")
 
 
 @dataclasses.dataclass
@@ -85,6 +97,11 @@ class Request:
     prefill_target: int = 0
     prefill_chunk: int = 0             # tokens to feed THIS iteration
     prefix_hit_tokens: int = 0         # prompt tokens skipped at admission
+    # Speculative-decode acceptance telemetry (serving/spec.py): drafts
+    # proposed / accepted over this request's verify steps.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_steps: int = 0
     _blocks_registered: int = 0        # prompt blocks published to the index
     _prompt_digests = None             # lazily built chained block digests
     _key = None                        # lazily built [2] uint32 PRNG key
@@ -115,13 +132,19 @@ class Scheduler:
 
     def __init__(self, cache: PagedKVCache, *, watermark_blocks: int = 0,
                  max_prefill_rows: Optional[int] = None,
-                 prefill_chunk_tokens: Optional[int] = None):
+                 prefill_chunk_tokens: Optional[int] = None,
+                 spec_reserve_tokens: int = 0):
         if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
             raise ValueError(f"prefill_chunk_tokens={prefill_chunk_tokens}")
         self.cache = cache
         self.watermark = watermark_blocks
         self.max_prefill_rows = max_prefill_rows or cache.slots
         self.prefill_chunk_tokens = prefill_chunk_tokens
+        # Speculative decode: admission budgets blocks for the context
+        # PLUS a worst-case draft window (K+1 tokens), so a verify step's
+        # write-ahead growth is pre-priced and almost never needs the
+        # preemption backstop mid-flight.
+        self.spec_reserve_tokens = spec_reserve_tokens
         self.waiting: Deque[Request] = deque()
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(cache.slots))
@@ -160,9 +183,15 @@ class Scheduler:
             req = self.waiting[0]
             ctx = req.context_len()
             shared, matched = self.cache.prefix_lookup(req.prompt)
-            need = self.cache.blocks_for(ctx) - len(shared)
+            budget_blocks = min(
+                self.cache.blocks_for(ctx + self.spec_reserve_tokens),
+                self.cache.max_blocks)
+            need = budget_blocks - len(shared)
             if need + self.watermark > self.cache.available_blocks:
                 break
+            # Only the context's blocks are allocated now; the reserve
+            # margin just gates admission (growth stays just-in-time).
+            need = self.cache.blocks_for(ctx) - len(shared)
             self.waiting.popleft()
             fresh = self.cache.alloc_blocks(need)
             assert fresh is not None  # guarded by the budget check
@@ -236,6 +265,39 @@ class Scheduler:
                 self.cache.extend(req.slot, got)
             stepped.append(req)
         return stepped
+
+    def ensure_spec_blocks(self, reqs: List[Request],
+                           window_tokens) -> List[Request]:
+        """Speculative-decode block growth: each request about to verify
+        a draft window gets enough blocks for ``cached_tokens() +
+        window_tokens[rid]`` BEFORE the step, so the verifier's
+        write-ahead K/V scatter can never land outside the table.
+        Same preemption backstop and return contract as
+        ``ensure_decode_blocks``."""
+        want = {r.rid for r in reqs}
+        stepped: List[Request] = []
+        for req in list(self.running):
+            if req.status != "running" or req.rid not in want:
+                continue  # preempted as an earlier request's victim
+            if req.prefilling():
+                continue
+            need_tokens = req.cached_tokens() + window_tokens[req.rid]
+            need = (self.cache.blocks_for(need_tokens)
+                    - len(self.cache.slot_blocks(req.slot)))
+            if need > 0:
+                got = self._alloc_with_preemption(need, req)
+                if got is None:
+                    continue  # req itself was the last resort victim
+                self.cache.extend(req.slot, got)
+            stepped.append(req)
+        return stepped
+
+    def shrink_spec_blocks(self, req: Request) -> int:
+        """Post-verify rewind: reclaim blocks grown past the accept
+        point. Keeps exactly the blocks the accepted cache contents
+        occupy; the next step's growth re-allocates just-in-time."""
+        keep = self.cache.blocks_for(max(1, req.cached_tokens()))
+        return self.cache.shrink(req.slot, keep)
 
     def _alloc_with_preemption(self, n: int, requester: Request):
         while True:

@@ -263,7 +263,10 @@ def summarize(records: List[dict]) -> dict:
             "concurrency", "workload", "lane", "prefill_chunk",
             "prefix_cache", "prefill_chunks", "prefix_hit_rate",
             "prefix_hit_tokens", "prompt_tokens",
-            "prefix_evictions") if s.get(k) is not None}
+            "prefix_evictions", "spec", "spec_k", "spec_steps",
+            "spec_drafted", "spec_accepted", "spec_accept_mean",
+            "spec_accept_rate", "spec_accept_hist",
+            ) if s.get(k) is not None}
 
     decodes = by_kind.get("decode", [])
     if decodes:
@@ -494,6 +497,15 @@ def render(report: dict) -> List[str]:
                 f" ({s.get('prefix_hit_tokens') or 0}"
                 f"/{s.get('prompt_tokens') or 0} prompt tokens,"
                 f" {s.get('prefix_evictions') or 0} evictions)")
+        if s.get("spec") and s.get("spec") != "off":
+            lines.append(
+                f"serve   spec {s['spec']} k={s.get('spec_k')}:"
+                f" {_fmt(s.get('spec_accept_mean'))} accepted drafts/step"
+                f" (rate {_fmt(s.get('spec_accept_rate'))},"
+                f" {s.get('spec_accepted') or 0}"
+                f"/{s.get('spec_drafted') or 0} over"
+                f" {s.get('spec_steps') or 0} verify steps)"
+                f" hist {s.get('spec_accept_hist')}")
     src = report.get("sources")
     if src:
         parts = "  ".join(
@@ -539,7 +551,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             grow_tol: float = 120.0,
             pack_tol: float = 0.05,
             plan_tol: float = 0.30,
-            moe_drop_tol: float = 0.0) -> List[dict]:
+            moe_drop_tol: float = 0.0,
+            spec_accept_tol: float = 0.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -731,6 +744,27 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "absolute": True,
         })
 
+    # Speculative-decode acceptance gate: only gates runs whose serve
+    # record ran with a proposer; mean accepted drafts per verify step
+    # must clear the absolute floor (0.0 default = always passes — set
+    # per workload, e.g. --spec-accept-tol 1.0 on a repetitive trace).
+    new_accept = (get(new, "serve", "spec_accept_mean")
+                  if (get(new, "serve", "spec") or "off") != "off" else None)
+    if new_accept is None:
+        verdicts.append({"metric": "spec_accept_mean", "verdict": "SKIP",
+                         "base": get(base, "serve", "spec_accept_mean"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "spec_accept_mean",
+            "verdict": ("FAIL" if new_accept < spec_accept_tol - eps
+                        else "PASS"),
+            "base": get(base, "serve", "spec_accept_mean"),
+            "new": round(new_accept, 4),
+            "tolerance": spec_accept_tol,
+            "absolute": True,
+        })
+
     new_rec_max = get(new, "elastic", "recovery_seconds_max")
     if new_rec_max is None:
         verdicts.append({"metric": "recovery_seconds_max", "verdict": "SKIP",
@@ -814,6 +848,9 @@ def render_verdicts(verdicts: List[dict]) -> List[str]:
                 tol = f", tol {_fmt(v['tolerance_s'], 0)}s abs"
             elif v.get("tolerance_frac") is not None:
                 tol = f", tol {_fmt(v['tolerance_frac'] * 100, 0)}% abs"
+            elif v.get("tolerance") is not None:
+                # Plain-units absolute floor (e.g. accepted tokens/step).
+                tol = f", floor {_fmt(v['tolerance'], 2)} abs"
             else:
                 tol = ""
             lines.append(
@@ -870,6 +907,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "at any captured step (default 0.0 — dropless "
                              "means dropless); SKIP for capacity-mode or "
                              "non-MoE runs")
+    parser.add_argument("--spec-accept-tol", type=float, default=0.0,
+                        help="ABSOLUTE gate on speculative decoding: FAIL "
+                             "if a spec-enabled serve run's mean accepted "
+                             "drafts per verify step falls below this floor "
+                             "(default 0.0 — always passes); SKIP when the "
+                             "new run served without a proposer")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -894,7 +937,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             serve_lat_tol=args.serve_lat_tol,
             recovery_tol=args.recovery_tol, grow_tol=args.grow_tol,
             pack_tol=args.pack_tol, plan_tol=args.plan_tol,
-            moe_drop_tol=args.moe_drop_tol)
+            moe_drop_tol=args.moe_drop_tol,
+            spec_accept_tol=args.spec_accept_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))
