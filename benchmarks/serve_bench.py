@@ -37,9 +37,22 @@ for ``adversarial``, prefix cache off vs on for ``shared_prefix``, spec
 decode off vs on when ``--spec`` is set — and ``--update-md`` splices
 the lane table into ``benchmarks/results.md``.
 
+``--replicas N`` routes the trace through the multi-replica front-end
+(``serving/frontend.py``) instead of a single engine: ``--routing``
+picks the policy, ``--ab`` becomes a random-vs-policy routing A/B over
+the same multi-group shared-prefix trace (``--prefix-groups``, default
+``2*replicas+2`` — more hot prefixes than replicas), ``--replica-kill
+N`` adds a lane that kills one replica at front-end iteration N
+mid-run, and ``--max-queue`` / ``--wait-watermark`` bound admission.
+Emits ``kind="frontend"`` records (aggregate tok/s, per-replica prefix
+hit rates, reject rate, load imbalance, failover counts) gated by
+``analyze.py --reject-tol`` and its categorical affinity-vs-random
+check; the drain gate asserts every ACCEPTED request finished.
+
     python benchmarks/serve_bench.py [--requests 32] [--concurrency 8]
     python benchmarks/serve_bench.py --workload adversarial --ab --update-md
     python benchmarks/serve_bench.py --workload repetitive --spec ngram --ab
+    python benchmarks/serve_bench.py --workload shared_prefix --replicas 3 --ab
     python benchmarks/serve_bench.py --trace benchmarks/traces/sample_trace.jsonl
     python benchmarks/serve_bench.py --smoke          # CPU CI gate
 
@@ -189,6 +202,30 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-len", type=int, default=0,
                    help="shared_prefix workload: shared system-prompt "
                         "tokens (0 = half of min prompt len)")
+    p.add_argument("--prefix-groups", type=int, default=0,
+                   help="shared_prefix workload: distinct system prompts, "
+                        "round-robin over requests (0 = auto: 1 for a "
+                        "single engine, 2*replicas+2 with --replicas — "
+                        "more groups than replicas is what routing can "
+                        "exploit)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="run the multi-replica front-end with N engine "
+                        "replicas instead of one engine (0 = single "
+                        "engine; serving/frontend.py)")
+    p.add_argument("--routing", default="affinity",
+                   choices=("affinity", "random", "least_loaded"),
+                   help="front-end routing policy (--replicas); with --ab "
+                        "the lanes become random vs this policy")
+    p.add_argument("--replica-kill", type=int, default=0,
+                   help="with --replicas: add a lane that kills one "
+                        "replica at this front-end iteration "
+                        "(replica_kill fault) and proves failover drains")
+    p.add_argument("--max-queue", type=int, default=0,
+                   help="front-end per-replica waiting-queue bound "
+                        "(0 = requests, i.e. no rejects from depth)")
+    p.add_argument("--wait-watermark", type=float, default=0.0,
+                   help="front-end oldest-wait admission watermark, "
+                        "seconds (0 = off)")
     p.add_argument("--ab", action="store_true",
                    help="run the workload as an A/B lane pair: unchunked "
                         "vs chunked (adversarial), prefix off vs on "
@@ -219,6 +256,13 @@ def main(argv=None) -> int:
         args.vocab, args.max_seq_len = 256, 64
         args.prompt_len, args.max_new = "4,12", 8
         args.block_size = 8
+        if args.replicas > 0:
+            # Multi-replica smoke needs prompts long enough to hold full
+            # shared blocks, else no prefix key exists and the routing
+            # A/B degenerates to cold-start noise.
+            args.prompt_len = "24,40"
+            if args.prefix_len == 0:
+                args.prefix_len = 16
         args.no_baseline = True
         if args.ttft_p99_gate == 0.0:
             args.ttft_p99_gate = 60.0
@@ -303,18 +347,27 @@ def main(argv=None) -> int:
         return trace
 
     def shared_prefix_trace():
-        """Every prompt opens with the same system prompt; tails differ."""
+        """Prompts open with a shared system prompt; tails differ. With
+        ``--prefix-groups G`` there are G distinct system prompts round-
+        robined over the requests — the multi-replica case: more hot
+        prefixes than replicas is the traffic affinity routing exploits
+        (random routing scatters each group over every replica, so every
+        replica pays every group's cold prefill)."""
         pfx_len = args.prefix_len or max(args.block_size, plo // 2)
         pfx_len = min(pfx_len, plo - 1)
+        groups = args.prefix_groups
+        if groups <= 0:
+            groups = 1 if args.replicas <= 0 else 2 * args.replicas + 2
         rs = np.random.RandomState(args.seed)
-        system = rs.randint(1, args.vocab, size=pfx_len).tolist()
+        systems = [rs.randint(1, args.vocab, size=pfx_len).tolist()
+                   for _ in range(groups)]
         trace = []
         for i in range(args.requests):
             plen = int(rs.randint(plo, phi + 1))
             tail = rs.randint(1, args.vocab, size=plen - pfx_len).tolist()
             trace.append(Request(
                 rid=i,
-                prompt=[int(t) for t in system + tail],
+                prompt=[int(t) for t in systems[i % groups] + tail],
                 max_new_tokens=args.max_new,
                 sampling=SamplingParams(temperature=0.0, seed=100 + i),
                 arrival_time=0.0,
@@ -357,6 +410,9 @@ def main(argv=None) -> int:
                       "shared_prefix": shared_prefix_trace,
                       "repetitive": repetitive_trace}[args.workload]
         workload = args.workload
+
+    if args.replicas > 0:
+        return _run_frontend_lanes(args, params, cfg, make_trace, workload)
 
     draft_params = draft_config = None
     if args.spec == "draft":
@@ -607,6 +663,219 @@ def main(argv=None) -> int:
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
     return 1 if failures else 0
+
+
+def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
+    """Multi-replica lanes (``--replicas N``): the same trace through the
+    serving front-end, one lane per routing policy (``--ab``: random vs
+    the chosen policy — the cache-affinity A/B) plus an optional
+    mid-run ``--replica-kill`` failover lane. Emits ``kind="frontend"``
+    records; the drain gate checks the front-end's conservation invariant
+    (every ACCEPTED request finished — rejects are backpressure, not
+    losses)."""
+    import json
+
+    import numpy as np
+
+    from tpu_trainer.serving.engine import request_metrics
+    from tpu_trainer.serving.frontend import ServingFrontend
+    from tpu_trainer.utils import faults
+    from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+    def build(routing):
+        return ServingFrontend(
+            params, cfg, replicas=args.replicas, routing=routing,
+            max_batch=args.concurrency, block_size=args.block_size,
+            num_blocks=args.num_blocks or None, kv_int8=args.kv_int8,
+            attention=args.attention,
+            prefill_chunk_tokens=args.prefill_chunk or None,
+            prefix_cache=True,
+            max_queue_depth=args.max_queue or max(args.requests, 1),
+            wait_watermark=args.wait_watermark or None,
+            seed=args.seed,
+        )
+
+    def run_lane(lane, routing, kill_step=0):
+        build(routing).run(make_trace())   # warm-up: compiles every shape
+        fe = build(routing)
+        if kill_step > 0:
+            with faults.plan(f"replica_kill@{kill_step}"):
+                finished = fe.run(make_trace())
+        else:
+            finished = fe.run(make_trace())
+        s = fe.summary()
+        lat = request_metrics(finished)
+        drained = int(s["finished"]) == int(s["accepted"])
+        record = {
+            "kind": "frontend",
+            "schema_version": SCHEMA_VERSION,
+            "workload": workload,
+            "lane": lane,
+            "routing": routing,
+            "replicas": args.replicas,
+            "replicas_live": int(s["replicas_live"]),
+            "n_requests": args.requests,
+            "concurrency": args.concurrency,
+            "block_size": args.block_size,
+            "prefix_groups": args.prefix_groups,
+            "model": {"hidden": args.hidden, "layers": args.layers,
+                      "heads": args.heads, "vocab": args.vocab},
+            "tokens_per_s": round(float(s["tokens_per_s"]), 2),
+            "generated_tokens": int(s["generated_tokens"]),
+            "wall_s": round(float(s["wall_s"]), 4),
+            "submitted": int(s["submitted"]),
+            "accepted": int(s["accepted"]),
+            "rejected": int(s["rejected"]),
+            "reject_rate": round(float(s["reject_rate"]), 4),
+            "prompt_tokens": int(s["prompt_tokens"]),
+            "prefix_hit_tokens": int(s["prefix_hit_tokens"]),
+            "prefix_hit_rate": round(float(s["prefix_hit_rate"]), 4),
+            "load_imbalance_mean": round(float(s["load_imbalance_mean"]), 3),
+            "load_imbalance_max": round(float(s["load_imbalance_max"]), 3),
+            "failover_events": int(s["failover_events"]),
+            "failed_over_requests": int(s["failed_over_requests"]),
+            "wait_age_p50_s": round(float(s.get("wait_age_p50", 0.0)), 5),
+            "wait_age_p99_s": round(float(s.get("wait_age_p99", 0.0)), 5),
+            "routed": {k[len("routed_"):]: int(v) for k, v in s.items()
+                       if str(k).startswith("routed_")},
+            "per_replica": [
+                {"replica": p["replica"], "alive": p["alive"],
+                 "finished": p["finished"],
+                 "generated_tokens": p["generated_tokens"],
+                 "prefix_hit_rate": round(p["prefix_hit_rate"], 4)}
+                for p in s["per_replica"]],
+        }
+        for name, series in lat.items():
+            if series:
+                record[f"{name}_p50_s"] = round(
+                    float(np.percentile(series, 50)), 5)
+                record[f"{name}_p99_s"] = round(
+                    float(np.percentile(series, 99)), 5)
+        return record, drained
+
+    lanes = []
+    if args.ab:
+        b_routing = args.routing if args.routing != "random" else "affinity"
+        lanes = [("random", "random", 0), (b_routing, b_routing, 0)]
+    else:
+        lanes = [(args.routing, args.routing, 0)]
+    if args.replica_kill > 0:
+        lanes.append(("replica_kill", args.routing, args.replica_kill))
+
+    records, all_drained = [], True
+    for lane, routing, kill in lanes:
+        rec, drained = run_lane(lane, routing, kill)
+        all_drained = all_drained and drained
+        records.append(rec)
+
+    if args.ab and len(records) >= 2:
+        a, b = records[0], records[1]
+        # The categorical affinity-vs-random gate (tools/analyze.py)
+        # reads both hit rates out of the SAME A/B record.
+        b["random_prefix_hit_rate"] = a["prefix_hit_rate"]
+        b["tok_s_vs_random"] = round(
+            b["tokens_per_s"] / max(a["tokens_per_s"], 1e-9), 3)
+
+    for rec in records:
+        _print_frontend_record(rec)
+        print(json.dumps(rec), flush=True)
+    if args.ab and len(records) >= 2:
+        a, b = records[0], records[1]
+        print(f"A/B     {b['lane']} vs random routing: prefix hit rate "
+              f"{b['prefix_hit_rate']:.2f} vs {a['prefix_hit_rate']:.2f}, "
+              f"tok/s x{b['tok_s_vs_random']:.2f}", flush=True)
+        if args.update_md:
+            update_frontend_md(workload, records, args)
+
+    if args.out:
+        with open(args.out, "a") as fh:
+            for rec in records:
+                fh.write(json.dumps(rec) + "\n")
+
+    failures = []
+    if not all_drained:
+        failures.append(
+            "front-end did not drain (an accepted request never finished)")
+    if args.ttft_p99_gate > 0:
+        p99 = records[-1].get("ttft_p99_s")
+        if p99 is None or p99 > args.ttft_p99_gate:
+            failures.append(
+                f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
+    for f in failures:
+        print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
+    return 1 if failures else 0
+
+
+def _print_frontend_record(r) -> None:
+    print(f"{r['lane']:<12}{r['tokens_per_s']:10.1f} tok/s aggregate, "
+          f"{r['replicas']} replicas ({r['replicas_live']} live, routing "
+          f"{r['routing']}), {r['accepted']}/{r['submitted']} accepted, "
+          f"{r['generated_tokens']} tokens, {r['wall_s']:.2f}s", flush=True)
+    if "ttft_p50_s" in r:
+        print(f"TTFT    p50 {r['ttft_p50_s'] * 1e3:8.1f} ms   "
+              f"p99 {r['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
+    per = "/".join(f"{p['prefix_hit_rate']:.2f}" for p in r["per_replica"])
+    print(f"fleet   prefix hit rate {r['prefix_hit_rate']:.2f} "
+          f"(per-replica {per}) | reject rate {r['reject_rate']:.3f} "
+          f"({r['rejected']}/{r['submitted']}) | load imbalance mean "
+          f"{r['load_imbalance_mean']:.2f} max {r['load_imbalance_max']:.2f}"
+          f" | failovers {r['failover_events']} "
+          f"({r['failed_over_requests']} reqs) | routed {r['routed']}",
+          flush=True)
+
+
+def update_frontend_md(workload, records, args) -> None:
+    """Splice the multi-replica lane table into benchmarks/results.md
+    (marker block ``serving-replicas``, its own section)."""
+    start = "<!-- serving-replicas:start -->"
+    end = "<!-- serving-replicas:end -->"
+    m = records[0]["model"]
+    header = (
+        f"`python benchmarks/serve_bench.py --workload {workload} "
+        f"--replicas {records[0]['replicas']} --ab"
+        + (f" --replica-kill {args.replica_kill}"
+           if args.replica_kill else "")
+        + f"` — hidden {m['hidden']}, layers {m['layers']}, "
+        f"{records[0]['n_requests']} reqs @ concurrency "
+        f"{records[0]['concurrency']} per replica, "
+        f"{records[0]['prefix_groups'] or 'auto'} prefix groups, block "
+        f"{records[0]['block_size']} ({time.strftime('%Y-%m-%d')}).\n\n"
+    )
+    lines = [
+        "| Lane | routing | replicas | tok/s | TTFT p99 (ms) | hit rate "
+        "| per-replica hit | reject rate | failovers |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        per = " / ".join(
+            f"{p['prefix_hit_rate']:.2f}" for p in r["per_replica"])
+        lines.append(
+            f"| {r['lane']} | {r['routing']} "
+            f"| {r['replicas_live']}/{r['replicas']} "
+            f"| {r['tokens_per_s']:,.0f} "
+            f"| {(r.get('ttft_p99_s') or 0) * 1e3:.1f} "
+            f"| {r['prefix_hit_rate']:.2f} | {per} "
+            f"| {r['reject_rate']:.3f} | {r['failover_events']} |"
+        )
+    block = f"{start}\n{header}" + "\n".join(lines) + f"\n{end}"
+    section_head = "## Multi-replica serving"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if start in text:
+        text = text.split(start)[0] + block + text.split(end)[1]
+    elif section_head in text:
+        text = text.replace(f"{section_head}\n",
+                            f"{section_head}\n\n{block}\n", 1)
+    elif "\n## Dropless MoE" in text:
+        text = text.replace(
+            "\n## Dropless MoE",
+            f"\n{section_head}\n\n{block}\n\n## Dropless MoE", 1)
+    else:
+        text += f"\n{section_head}\n\n{block}\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote multi-replica serving table to {_RESULTS_MD}",
+          file=sys.stderr)
 
 
 def _print_record(record) -> None:

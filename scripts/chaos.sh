@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
-# Chaos lane: the full elastic fault matrix against a real supervisor
-# (training/elastic.py), one scenario per run dir. Every scenario bounds
+# Chaos lane: the full fault matrix — elastic training scenarios against
+# a real supervisor (training/elastic.py), one per run dir, plus the
+# serving-tier replica_kill drill. Every training scenario bounds
 # its restart budget with --max_restarts so a broken recovery fails the
 # lane instead of restarting forever; analyze.py gates each run's
 # supervisor.jsonl afterwards (recovery/grow seconds, restart count,
@@ -103,5 +104,18 @@ supervise grow_back 0 \
   --num_processes 2 --max_restarts 1 --allow_grow \
   --grow_probe_interval_s 0.2 -- \
   --inject_fault kill_host@5,return_host@6 --max_steps 64
+
+# 7. Serving tier (serving/frontend.py): one of three front-end replicas
+#    dies mid-bench. The bench's drain gate asserts every ACCEPTED
+#    request finished on the survivors; analyze then gates the run's own
+#    records — reject ceiling at zero (nothing may be shed on this tiny
+#    load) and the categorical affinity-vs-random hit-rate A/B.
+SERVE_OUT="$OUT/replica_kill.jsonl"
+rm -f "$SERVE_OUT"
+echo "== chaos: replica_kill (serving front-end) =="
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --replicas 3 --ab --replica-kill 6 --out "$SERVE_OUT"
+python -m tpu_trainer.tools.analyze "$SERVE_OUT" \
+  --compare "$SERVE_OUT" --reject-tol 0.0
 
 echo "chaos: full matrix clean ($OUT)"

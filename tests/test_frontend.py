@@ -1,0 +1,363 @@
+"""Multi-replica front-end tests (ISSUE 14): prefix-affinity routing,
+bounded-queue admission, replica failover, and capacity-driven resize.
+
+Tier-1 (this module is NOT in conftest's _SLOW_MODULES), all on CPU in
+deterministic ``time_mode="steps"``. The load-bearing assertions:
+
+- same-prefix traffic lands on ONE replica (affinity), and a hot shard
+  spills past the gap threshold instead of starving the fleet;
+- admission is reject-at-submit: queue depth never exceeds the bound,
+  watermark trips come back as structured rejects, nothing queues
+  unboundedly;
+- a replica killed mid-run fails its work over and every stream stays
+  BIT-IDENTICAL to an undisturbed single-engine run — the
+  (seed, token_index) preemption-resume argument, end to end;
+- capacity grants grow the fleet and shrink drains before teardown;
+- accounting conserves: accepted + rejected == submitted, and finished
+  == accepted once drained (failover moves requests, never duplicates
+  or drops them).
+
+The ``@pytest.mark.chaos`` lane drives the same kill through
+serve_bench's ``--replicas --replica-kill`` path and the analyze gates,
+mirroring scripts/chaos.sh.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT
+from tpu_trainer.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+)
+from tpu_trainer.utils import faults
+from tpu_trainer.utils.preemption import grant_capacity, read_capacity
+
+
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+                dtype="float32", param_dtype="float32")
+
+BLOCK = 8
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _fe(params, **kw):
+    kw.setdefault("replicas", 3)
+    kw.setdefault("routing", "affinity")
+    kw.setdefault("time_mode", "steps")
+    kw.setdefault("block_size", BLOCK)
+    kw.setdefault("attention", "reference")
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("max_batch", 4)
+    return ServingFrontend(params, CFG, **kw)
+
+
+def _prefix_requests(n, prefix_len=2 * BLOCK, tail=(4, 12), max_new=6,
+                     temperature=0.0, groups=1, seed=0):
+    """n requests sharing ``groups`` distinct full-block system prefixes.
+
+    A FRESH RandomState per call: two calls with the same arguments build
+    byte-identical traces, which the failover bit-identity test depends
+    on (baseline and front-end runs must see the same prompts)."""
+    rs = np.random.RandomState(seed)
+    systems = [rs.randint(1, CFG.vocab_size, size=prefix_len).tolist()
+               for _ in range(groups)]
+    reqs = []
+    for i in range(n):
+        t = rs.randint(1, CFG.vocab_size,
+                       size=rs.randint(tail[0], tail[1] + 1)).tolist()
+        reqs.append(Request(
+            rid=i, prompt=systems[i % groups] + t, max_new_tokens=max_new,
+            sampling=SamplingParams(temperature=temperature, seed=100 + i),
+        ))
+    return reqs
+
+
+# --- routing ---------------------------------------------------------------
+
+class TestRouting:
+    def test_affinity_routes_shared_prefix_to_one_replica(self, params):
+        fe = _fe(params, replicas=3, spill_tokens=None)
+        reqs = _prefix_requests(8)
+        for r in reqs:
+            res = fe.submit(r)
+            assert res.accepted and res.routed == "affinity"
+        assert len({fe.submit_results[r.rid].replica for r in reqs}) == 1
+        fin = fe.drain()
+        assert len(fin) == 8
+        s = fe.summary()
+        assert s["routed_affinity"] == 8
+        assert sorted(p["finished"] for p in s["per_replica"]) == [0, 0, 8]
+
+    def test_affinity_key_is_prefix_not_whole_prompt(self, params):
+        # Same leading block, divergent later blocks -> same replica:
+        # the key must be COARSE or shared-system-prompt traffic scatters.
+        fe = _fe(params, replicas=3, affinity_blocks=1)
+        reqs = _prefix_requests(6, prefix_len=BLOCK, tail=(17, 25))
+        for r in reqs:
+            fe.submit(r)
+        assert len({fe.submit_results[r.rid].replica for r in reqs}) == 1
+        fe.drain()
+
+    def test_short_prompt_routes_cold_to_least_loaded(self, params):
+        fe = _fe(params, replicas=2)
+        a = Request(rid=0, prompt=[1, 2, 3], max_new_tokens=4,
+                    sampling=SamplingParams(temperature=0.0, seed=1))
+        b = Request(rid=1, prompt=[4, 5, 6], max_new_tokens=4,
+                    sampling=SamplingParams(temperature=0.0, seed=2))
+        ra, rb = fe.submit(a), fe.submit(b)
+        assert ra.routed == rb.routed == "cold"
+        assert ra.replica != rb.replica   # second goes to the emptier one
+        fe.drain()
+
+    def test_hot_shard_spills_past_gap_threshold(self, params):
+        # Every request shares one prefix; with a small spill gap the
+        # affine replica cannot absorb them all and the overflow sheds
+        # to the least-loaded survivor instead of starving it.
+        fe = _fe(params, replicas=2, spill_tokens=20)
+        reqs = _prefix_requests(10, max_new=6)
+        for r in reqs:
+            assert fe.submit(r).accepted
+        s0 = fe.summary()
+        assert s0["routed_affinity"] >= 1
+        assert s0["routed_spill"] >= 1
+        fin = fe.drain()
+        assert len(fin) == 10
+        assert all(p["finished"] > 0 for p in fe.summary()["per_replica"])
+
+    def test_routing_policies_exist_and_validate(self, params):
+        with pytest.raises(ValueError, match="routing"):
+            _fe(params, routing="round_robin")
+        with pytest.raises(ValueError, match="replicas"):
+            _fe(params, replicas=0)
+
+
+# --- admission -------------------------------------------------------------
+
+class TestAdmission:
+    def test_queue_full_rejects_and_depth_stays_bounded(self, params):
+        fe = _fe(params, replicas=2, max_queue_depth=2)
+        reqs = _prefix_requests(10)
+        results = [fe.submit(r) for r in reqs]
+        accepted = [r for r in results if r.accepted]
+        rejected = [r for r in results if not r.accepted]
+        # 2 replicas x depth 2: the rest must come back as structured
+        # rejects, never a deeper queue.
+        assert len(accepted) == 4
+        assert len(rejected) == 6
+        assert all(r.reason == "queue_full" for r in rejected)
+        assert all(r.queue_depth >= 2 for r in rejected)
+        for h in fe._replicas:
+            assert h.engine.queue_depth <= 2
+        fin = fe.drain()
+        assert len(fin) == 4
+        s = fe.summary()
+        assert s["rejected_queue_full"] == 6
+        assert s["accepted"] + s["rejected"] == s["submitted"] == 10
+
+    def test_wait_watermark_rejects_with_observed_age(self, params):
+        fe = _fe(params, replicas=2, routing="least_loaded",
+                 wait_watermark=3.0)
+        old = _prefix_requests(2)
+        for r in old:
+            assert fe.submit(r).accepted
+        assert len({fe.submit_results[r.rid].replica for r in old}) == 2
+        fe._iters = 10   # steps-mode clock: both queues are now 10 old
+        late = Request(rid=99, prompt=list(range(1, 20)), max_new_tokens=4,
+                       sampling=SamplingParams(temperature=0.0, seed=9))
+        res = fe.submit(late)
+        assert not res.accepted
+        assert res.reason == "wait_watermark"
+        assert res.oldest_wait == pytest.approx(10.0)
+        fin = fe.drain()
+        assert len(fin) == 2
+
+    def test_inadmissible_affinity_target_sheds_before_rejecting(self, params):
+        # The affine replica's queue is full but a survivor has room:
+        # the submit must shed (routed="spill"), not reject.
+        fe = _fe(params, replicas=2, max_queue_depth=2, spill_tokens=None)
+        reqs = _prefix_requests(4)
+        results = [fe.submit(r) for r in reqs]
+        assert all(r.accepted for r in results)
+        assert {r.routed for r in results} == {"affinity", "spill"}
+        assert len(fe.drain()) == 4
+
+
+# --- failover --------------------------------------------------------------
+
+class TestFailover:
+    @pytest.mark.parametrize("temperature", [0.0, 0.8])
+    def test_killed_replica_streams_bit_identical(self, params, monkeypatch,
+                                                  temperature):
+        # THE acceptance property: kill the replica holding all the work
+        # mid-run; every stream must match an undisturbed single-engine
+        # run token for token. Sampling is keyed by (seed, token_index)
+        # and failover re-prefills prompt + generated-so-far, so the
+        # continuation cannot depend on the interruption.
+        def reqs():
+            return _prefix_requests(8, max_new=6, temperature=temperature)
+
+        eng = ServingEngine(params, CFG, block_size=BLOCK, max_batch=4,
+                            attention="reference", prefix_cache=True)
+        base = {r.rid: list(r.generated)
+                for r in eng.run(reqs(), time_mode="steps")}
+
+        fe = _fe(params, replicas=3)
+        victim = fe._rendezvous(
+            fe._affinity_key(reqs()[0].prompt), fe._live()).rid
+        monkeypatch.setenv("TPU_TRAINER_FAULT_REPLICA", str(victim))
+        with faults.plan("replica_kill@3"):
+            fin = fe.run(reqs())
+
+        s = fe.summary()
+        assert s["failover_events"] == 1
+        assert s["failed_over_requests"] >= 1
+        assert s["replicas_live"] == 2
+        assert len(fin) == 8
+        assert {r.rid: list(r.generated) for r in fin} == base
+
+    def test_kill_fails_over_queued_and_in_flight(self, params, monkeypatch):
+        fe = _fe(params, replicas=2, max_batch=2)
+        reqs = _prefix_requests(6, max_new=8)
+        for r in reqs:
+            assert fe.submit(r).accepted
+        victim = fe.submit_results[reqs[0].rid].replica
+        for _ in range(2):   # some in running, some still waiting
+            fe.step()
+        monkeypatch.setenv("TPU_TRAINER_FAULT_REPLICA", str(victim))
+        moved = fe.kill_replica()
+        assert moved >= 1
+        fin = fe.drain()
+        assert len(fin) == 6
+        s = fe.summary()
+        assert s["finished"] == s["accepted"] == 6
+
+    def test_cannot_kill_last_live_replica(self, params):
+        fe = _fe(params, replicas=1)
+        with pytest.raises(RuntimeError, match="last live"):
+            fe.kill_replica()
+        with pytest.raises(ValueError, match="not alive"):
+            _fe(params, replicas=2).kill_replica(17)
+
+
+# --- resize ----------------------------------------------------------------
+
+class TestResize:
+    def test_capacity_grant_grows_and_shrink_drains(self, params, tmp_path):
+        cap = str(tmp_path / "capacity.json")
+        fe = _fe(params, replicas=1, capacity_file=cap, max_replicas=3,
+                 capacity_probe_every=1)
+        grant_capacity(cap, 2)
+        reqs = _prefix_requests(6, groups=3)
+        for r in reqs:
+            assert fe.submit(r).accepted
+        fin = fe.drain()
+        assert len(fin) == 6
+        s = fe.summary()
+        assert s["replicas_live"] == 3
+        assert s["grows"] == 2
+        assert read_capacity(cap) == 0   # the grant was consumed
+
+        fe.shrink(2)
+        fe.drain()
+        s = fe.summary()
+        assert s["replicas_live"] == 1
+        assert s["retired_replicas"] == 2
+        assert s["finished"] == s["accepted"]
+
+    def test_shrink_reroutes_waiting_and_finishes_running(self, params):
+        fe = _fe(params, replicas=2, max_batch=2)
+        reqs = _prefix_requests(5, max_new=6)
+        for r in reqs:
+            assert fe.submit(r).accepted
+        fe.step()   # admit some into running on each replica
+        fe.shrink(1)
+        fin = fe.drain()
+        assert len(fin) == 5
+        s = fe.summary()
+        assert s["replicas_live"] == 1
+        assert s["retired_replicas"] == 1
+        assert s["finished"] == s["accepted"] == 5
+
+    def test_grow_respects_max_replicas(self, params):
+        fe = _fe(params, replicas=2, max_replicas=3)
+        assert fe.grow(5) == 1
+        assert len(fe._live()) == 3
+
+
+# --- accounting ------------------------------------------------------------
+
+class TestConservation:
+    def test_accounting_conserves_under_rejects_and_failover(
+            self, params, monkeypatch):
+        # Bounded queues force rejects; a mid-run kill forces failover.
+        # Neither may create or lose a request.
+        fe = _fe(params, replicas=3, max_queue_depth=3)
+        reqs = _prefix_requests(12, groups=3, max_new=6)
+        monkeypatch.delenv("TPU_TRAINER_FAULT_REPLICA", raising=False)
+        with faults.plan("replica_kill@3"):
+            fin = fe.run(reqs)
+        s = fe.summary()
+        assert s["accepted"] + s["rejected"] == s["submitted"] == 12
+        assert s["finished"] == s["accepted"] == len(fin)
+        assert s["in_flight"] == 0
+        assert s["rejected"] >= 1
+        assert s["failover_events"] == 1
+        # Every accepted rid finished exactly once; every rejected rid
+        # carries a structured reason and never finished.
+        fin_rids = [r.rid for r in fin]
+        assert len(fin_rids) == len(set(fin_rids))
+        for r in reqs:
+            res = fe.submit_results[r.rid]
+            assert res.accepted == (r.rid in set(fin_rids))
+            if not res.accepted:
+                assert res.reason in ("queue_full", "wait_watermark")
+
+    def test_summary_aggregates_match_per_replica(self, params):
+        fe = _fe(params, replicas=2)
+        fe.run(_prefix_requests(6, groups=2))
+        s = fe.summary()
+        assert s["generated_tokens"] == sum(
+            p["generated_tokens"] for p in s["per_replica"])
+        assert s["finished"] == sum(
+            p["finished"] for p in s["per_replica"])
+
+
+# --- the chaos lane (serve_bench + analyze gates) --------------------------
+
+@pytest.mark.chaos
+class TestReplicaKillChaosLane:
+    def test_bench_kill_lane_and_analyze_gates(self, tmp_path):
+        # One of three replicas dies mid-bench: the bench's drain gate
+        # asserts every ACCEPTED request finished, and analyze's absolute
+        # reject ceiling + categorical affinity-vs-random gate both pass
+        # on the run's own records (self-compare, like scripts/chaos.sh).
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            import serve_bench
+        finally:
+            sys.path.pop(0)
+        out = str(tmp_path / "frontend.jsonl")
+        assert serve_bench.main(
+            ["--smoke", "--workload", "shared_prefix", "--replicas", "3",
+             "--ab", "--replica-kill", "6", "--out", out]) == 0
+        from tpu_trainer.tools.analyze import main as analyze_main
+        assert analyze_main(
+            [out, "--compare", out, "--reject-tol", "0.0"]) == 0

@@ -18,13 +18,22 @@ a *request stream* —
   draft-model proposers drafting K tokens that ONE target forward
   verifies over the paged cache (write-ahead + host rewind), greedy
   streams bit-identical to non-speculative decode.
-- ``engine``       — the front-end: jitted prefill/decode steps over the
+- ``engine``       — one replica: jitted prefill/decode steps over the
   paged model path (``GPTConfig.decode_paged``), latency/throughput
   stats, and a ``python -m tpu_trainer.serving.engine`` CLI replaying a
   seeded open-loop Poisson arrival trace.
+- ``frontend``     — the request tier above N engine replicas:
+  prefix-affinity routing (same chained block digests as the prefix
+  index, rendezvous-hashed over the live set), bounded queues with
+  reject-at-submit backpressure, replica failover with token-identical
+  resume, and capacity-file driven grow/shrink.
 """
 
 from tpu_trainer.serving.engine import ServingEngine, poisson_trace  # noqa: F401
+from tpu_trainer.serving.frontend import (  # noqa: F401
+    ServingFrontend,
+    SubmitResult,
+)
 from tpu_trainer.serving.paged_cache import BlockPool, PagedKVCache  # noqa: F401
 from tpu_trainer.serving.scheduler import (  # noqa: F401
     Request,

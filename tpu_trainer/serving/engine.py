@@ -438,6 +438,33 @@ class ServingEngine:
             self._t0 = self.clock()
         return self.clock() - self._t0
 
+    # -- load signals (the multi-replica router's inputs; also summary
+    # telemetry for single-engine runs) ------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for admission."""
+        return self.scheduler.queue_depth
+
+    @property
+    def outstanding_tokens(self) -> int:
+        """Token-steps of work still owed (waiting + running)."""
+        return self.scheduler.outstanding_tokens
+
+    def oldest_wait_age(self, now: Optional[float] = None) -> float:
+        """How long (engine clock units) the longest-waiting queued
+        request has been waiting; 0.0 with an empty queue."""
+        arr = self.scheduler.oldest_waiting_arrival
+        if arr is None:
+            return 0.0
+        return max(0.0, (self._now() if now is None else now) - arr)
+
+    def export_requests(self, *, waiting_only: bool = False):
+        """Drain this engine's requeueable request state (see
+        ``Scheduler.export_requests``) — the failover / shrink-teardown
+        path of the multi-replica front-end."""
+        return self.scheduler.export_requests(waiting_only=waiting_only)
+
     # -- trace replay ------------------------------------------------------
 
     def run(
@@ -493,6 +520,10 @@ class ServingEngine:
             / max(1, self.scheduler.prompt_tokens)
         )
         s["prefix_evictions"] = self.cache_state.n_prefix_evictions
+        s["queue_depth"] = self.queue_depth
+        s["outstanding_tokens"] = self.outstanding_tokens
+        s["oldest_wait_s"] = (
+            self.oldest_wait_age() if self.scheduler.waiting else 0.0)
         if self.spec_decoder is not None:
             s["spec_accept_mean"] = (
                 s["spec_accepted"] / max(1, int(s["spec_steps"])))

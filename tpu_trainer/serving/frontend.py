@@ -1,0 +1,520 @@
+"""Multi-replica serving front-end: prefix-affinity routing, SLO-aware
+admission control, replica failover, and capacity-driven resize.
+
+Everything below ``ServingFrontend`` is the single-engine stack
+unchanged: each replica is a full ``ServingEngine`` (its own scheduler,
+paged block pool, and prefix index) built from the SAME params/config —
+``GPTConfig`` is frozen and the jitted step is memoised per config
+(``engine._jitted_engine_step``), so N replicas share one compile cache
+and cost no extra retraces. The front-end owns the request tier above:
+
+- **Prefix-affinity routing** (``routing="affinity"``): the routing key
+  is the chained blake2b digest of the prompt's leading full blocks —
+  literally the same hash the per-engine prefix index uses
+  (``paged_cache.chained_block_digests``) — mapped to a replica by
+  rendezvous (highest-random-weight) hashing over the live set, so the
+  mapping is stable under grow/shrink/failover: resizing moves only the
+  keys that must move. Shared-prefix traffic therefore lands on the one
+  replica whose copy-on-write cache already holds the prefix, instead
+  of every replica paying the cold prefill (what ``random`` and pure
+  ``least_loaded`` routing cost on correlated traffic). Prompts with no
+  full block route least-outstanding-tokens (cold fallback), and a
+  ``spill_tokens`` gap threshold sheds an over-affine hot shard to the
+  least-loaded survivor so affinity can never starve the rest of the
+  fleet.
+- **SLO-aware admission control**: per-replica queues are bounded
+  (``max_queue_depth``) and carry an oldest-wait age watermark
+  (``wait_watermark``, in front-end clock units). A submit that lands
+  on a replica past either limit first tries to shed to a live replica
+  with room; if none exists the request is REJECTED at submit with a
+  structured ``SubmitResult`` (reason, observed depth and wait age) —
+  backpressure the caller can act on, never a silently unbounded queue.
+  Rejects, queue depths, and wait-age percentiles surface in
+  ``summary()``.
+- **Replica failover**: ``kill_replica`` (driven by the
+  ``replica_kill@N`` fault kind, ``utils/faults.py``) marks a replica
+  dead, exports its queued AND in-flight requests with runtime state
+  reset (``Scheduler.export_requests``), and resubmits them to the
+  survivors. Resumed streams are token-identical to an undisturbed run
+  by the preemption-resume argument: re-admission re-prefills prompt +
+  generated-so-far and sampling is keyed by (seed, token index), so the
+  continuation cannot depend on where — or how often — it was
+  interrupted.
+- **Capacity-driven resize**: the front-end probes the
+  ``utils/preemption.py`` capacity file every ``capacity_probe_every``
+  iterations and consumes grants to grow toward ``max_replicas`` (the
+  same grant/consume protocol the elastic trainer uses for host
+  grow-back). ``shrink`` marks the highest-id replicas draining:
+  their waiting requests re-route immediately, their running requests
+  finish in place, and the replica is torn down only once idle.
+
+Time: the front-end owns one clock domain shared by every replica
+(engines are built with ``clock=`` the front-end's ``_now`` and a zero
+epoch), so arrival times, wait ages, and token timestamps are all
+comparable across replicas — in seconds (``time_mode="wall"``) or
+front-end iterations (``"steps"``, fully deterministic for tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.serving.engine import ServingEngine
+from tpu_trainer.serving.paged_cache import chained_block_digests
+from tpu_trainer.serving.scheduler import Request
+from tpu_trainer.utils import faults
+from tpu_trainer.utils.preemption import consume_capacity, read_capacity
+
+ROUTINGS = ("affinity", "random", "least_loaded")
+
+
+@dataclasses.dataclass
+class SubmitResult:
+    """Structured outcome of one ``submit``: where the request went, or
+    why it was shed. ``routed`` records the decision path (affinity /
+    cold / spill / random / least_loaded / failover); on a reject it is
+    None and ``reason`` says which limit tripped (queue_full |
+    wait_watermark), with the depth and wait age observed at the
+    decision — the caller's backpressure signal."""
+
+    accepted: bool
+    replica: Optional[int] = None
+    routed: Optional[str] = None
+    reason: Optional[str] = None
+    queue_depth: int = 0
+    oldest_wait: float = 0.0
+
+
+@dataclasses.dataclass
+class _Replica:
+    """One engine replica plus its front-end bookkeeping."""
+
+    rid: int
+    engine: ServingEngine
+    alive: bool = True
+    draining: bool = False
+    finished: int = 0
+    routed: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class ServingFrontend:
+    """N in-process ``ServingEngine`` replicas behind one
+    submit/step/drain surface."""
+
+    def __init__(
+        self,
+        params,
+        config: GPTConfig,
+        *,
+        replicas: int = 2,
+        routing: str = "affinity",
+        affinity_blocks: int = 1,
+        spill_tokens: Optional[int] = 512,
+        max_queue_depth: int = 64,
+        wait_watermark: Optional[float] = None,
+        capacity_file: Optional[str] = None,
+        max_replicas: Optional[int] = None,
+        capacity_probe_every: int = 8,
+        time_mode: str = "wall",
+        clock=time.perf_counter,
+        seed: int = 0,
+        **engine_kwargs,
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas={replicas}")
+        if routing not in ROUTINGS:
+            raise ValueError(f"routing={routing!r} (one of {ROUTINGS})")
+        if affinity_blocks < 1:
+            raise ValueError(f"affinity_blocks={affinity_blocks}")
+        if max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth={max_queue_depth}")
+        if time_mode not in ("wall", "steps"):
+            raise ValueError(f"time_mode={time_mode!r}")
+        self.params = params
+        self.config = config
+        self.routing = routing
+        self.affinity_blocks = affinity_blocks
+        self.spill_tokens = spill_tokens
+        self.max_queue_depth = max_queue_depth
+        self.wait_watermark = wait_watermark
+        self.capacity_file = capacity_file
+        self.max_replicas = max_replicas
+        self.capacity_probe_every = max(1, capacity_probe_every)
+        self.time_mode = time_mode
+        self.clock = clock
+        self._engine_kwargs = engine_kwargs
+        self._rs = np.random.RandomState(seed)
+        self._replicas: List[_Replica] = []
+        self._next_rid = 0
+        self._iters = 0
+        self._t0: Optional[float] = None
+        self.wall_elapsed = 0.0
+        self.submit_results: Dict[int, SubmitResult] = {}
+        self._wait_samples: List[float] = []
+        self.stats: Dict[str, float] = {
+            "submitted": 0, "accepted": 0, "rejected": 0,
+            "rejected_queue_full": 0, "rejected_wait_watermark": 0,
+            "finished": 0,
+            "failover_events": 0, "failed_over_requests": 0,
+            "grows": 0, "shrinks": 0, "retired_replicas": 0,
+            "imbalance_sum": 0.0, "imbalance_samples": 0,
+            "imbalance_max": 0.0,
+        }
+        for _ in range(replicas):
+            self._spawn_replica()
+        self.block_size = self._replicas[0].engine.cache_state.block_size
+
+    # -- replica set -------------------------------------------------------
+
+    def _spawn_replica(self) -> _Replica:
+        eng = ServingEngine(
+            self.params, self.config, clock=self._now, **self._engine_kwargs)
+        # Replicas live in the front-end's clock domain: zero epoch, so
+        # engine timestamps ARE front-end times and wait ages computed
+        # against request arrival_time are comparable across replicas.
+        eng._t0 = 0.0
+        h = _Replica(rid=self._next_rid, engine=eng)
+        self._next_rid += 1
+        self._replicas.append(h)
+        return h
+
+    def _live(self, *, routable: bool = False) -> List[_Replica]:
+        return [h for h in self._replicas
+                if h.alive and not (routable and h.draining)]
+
+    def has_work(self) -> bool:
+        return any(h.engine.scheduler.has_work() for h in self._live())
+
+    def _now(self) -> float:
+        if self.time_mode == "steps":
+            return float(self._iters)
+        if self._t0 is None:
+            self._t0 = self.clock()
+        return self.clock() - self._t0
+
+    # -- routing -----------------------------------------------------------
+
+    def _affinity_key(self, prompt: List[int]) -> Optional[bytes]:
+        """Chained digest of the prompt's leading full blocks (capped at
+        ``affinity_blocks`` — coarse on purpose: requests sharing a
+        system prefix but diverging later must still share a key), or
+        None when the prompt has no full block (cold)."""
+        n = min(len(prompt) // self.block_size, self.affinity_blocks)
+        if n == 0:
+            return None
+        digs = chained_block_digests(
+            prompt[:n * self.block_size], self.block_size)
+        return digs[-1]
+
+    @staticmethod
+    def _rendezvous(key: bytes, cands: List[_Replica]) -> _Replica:
+        """Highest-random-weight hashing: each replica scores
+        blake2b(key + rid); the max wins. Adding/removing a replica
+        remaps only the keys whose winner changed — affinity survives
+        resize and failover with minimal cache churn."""
+        best, best_score = cands[0], -1
+        for h in cands:
+            score = int.from_bytes(
+                hashlib.blake2b(
+                    key + h.rid.to_bytes(8, "little"), digest_size=8
+                ).digest(), "little")
+            if score > best_score:
+                best, best_score = h, score
+        return best
+
+    @staticmethod
+    def _load(h: _Replica) -> Tuple[int, int]:
+        return (h.engine.outstanding_tokens, h.rid)
+
+    def _route(self, req: Request) -> Tuple[_Replica, str]:
+        live = self._live(routable=True)
+        if not live:
+            raise RuntimeError("no live replicas to route to")
+        if self.routing == "random":
+            return live[int(self._rs.randint(len(live)))], "random"
+        if self.routing == "least_loaded":
+            return min(live, key=self._load), "least_loaded"
+        key = self._affinity_key(req.prompt)
+        if key is None:
+            return min(live, key=self._load), "cold"
+        target = self._rendezvous(key, live)
+        least = min(live, key=self._load)
+        if (self.spill_tokens is not None
+                and target.engine.outstanding_tokens
+                - least.engine.outstanding_tokens > self.spill_tokens):
+            return least, "spill"
+        return target, "affinity"
+
+    # -- admission ---------------------------------------------------------
+
+    def _admission_reason(self, h: _Replica, now: float) -> Optional[str]:
+        if h.engine.queue_depth >= self.max_queue_depth:
+            return "queue_full"
+        if (self.wait_watermark is not None
+                and h.engine.oldest_wait_age(now) > self.wait_watermark):
+            return "wait_watermark"
+        return None
+
+    def submit(self, req: Request) -> SubmitResult:
+        """Route + admission-check one request. Accepted requests join
+        the target replica's waiting queue; past-limit submits first
+        shed to a live replica with room and otherwise come back as a
+        structured reject — the queue is never unbounded."""
+        self.stats["submitted"] += 1
+        now = self._now()
+        target, routed = self._route(req)
+        reason = self._admission_reason(target, now)
+        if reason is not None:
+            alts = [h for h in self._live(routable=True) if h is not target
+                    and self._admission_reason(h, now) is None]
+            if alts:
+                target, routed, reason = min(alts, key=self._load), "spill", None
+        if reason is not None:
+            self.stats["rejected"] += 1
+            self.stats[f"rejected_{reason}"] += 1
+            res = SubmitResult(
+                accepted=False, reason=reason,
+                queue_depth=target.engine.queue_depth,
+                oldest_wait=target.engine.oldest_wait_age(now))
+            self.submit_results[req.rid] = res
+            return res
+        self._enqueue(target, req, routed)
+        res = SubmitResult(
+            accepted=True, replica=target.rid, routed=routed,
+            queue_depth=target.engine.queue_depth,
+            oldest_wait=target.engine.oldest_wait_age(now))
+        self.submit_results[req.rid] = res
+        return res
+
+    def _enqueue(self, h: _Replica, req: Request, routed: str) -> None:
+        h.engine.scheduler.add(req)
+        h.routed[routed] = h.routed.get(routed, 0) + 1
+        key = f"routed_{routed}"
+        self.stats[key] = self.stats.get(key, 0) + 1
+        if routed != "failover":
+            self.stats["accepted"] += 1
+
+    # -- failover ----------------------------------------------------------
+
+    def kill_replica(self, rid: Optional[int] = None) -> int:
+        """Mark a replica dead and fail its queued + in-flight requests
+        over to the survivors (admission limits do not apply — these
+        requests were already accepted; shedding them now would break
+        the submit-time contract). Default victim: the env override
+        ``TPU_TRAINER_FAULT_REPLICA``, else the highest-id live replica
+        (mirroring ``faults.target_host``'s highest-rank convention).
+        Returns the number of requests failed over."""
+        live = self._live()
+        if rid is None:
+            raw = os.environ.get("TPU_TRAINER_FAULT_REPLICA")
+            rid = int(raw) if raw is not None else max(h.rid for h in live)
+        victims = [h for h in live if h.rid == rid]
+        if not victims:
+            raise ValueError(f"replica {rid} is not alive")
+        if len(live) == 1:
+            raise RuntimeError("cannot kill the last live replica")
+        h = victims[0]
+        orphans = h.engine.export_requests()
+        h.alive = False
+        h.engine.device_cache = None   # release the KV pools
+        self.stats["failover_events"] += 1
+        self.stats["failed_over_requests"] += len(orphans)
+        for req in orphans:
+            target, _ = self._route(req)
+            self._enqueue(target, req, "failover")
+        return len(orphans)
+
+    # -- resize ------------------------------------------------------------
+
+    def grow(self, n: int = 1) -> int:
+        """Add up to ``n`` replicas (bounded by ``max_replicas``).
+        Returns how many were actually added."""
+        added = 0
+        while added < n and (self.max_replicas is None
+                             or len(self._live()) < self.max_replicas):
+            self._spawn_replica()
+            added += 1
+        self.stats["grows"] += added
+        return added
+
+    def shrink(self, n: int = 1) -> int:
+        """Mark the ``n`` highest-id live replicas draining: excluded
+        from routing immediately, waiting requests re-routed now,
+        running requests finish in place; teardown happens in ``step``
+        once the replica is idle. Never drains the last live replica."""
+        done = 0
+        while done < n and len(self._live(routable=True)) > 1:
+            h = max(self._live(routable=True), key=lambda x: x.rid)
+            h.draining = True
+            for req in h.engine.export_requests(waiting_only=True):
+                target, _ = self._route(req)
+                self._enqueue(target, req, "failover")
+            done += 1
+        self.stats["shrinks"] += done
+        return done
+
+    def _probe_capacity(self) -> int:
+        """Consume pending capacity grants into new replicas (the PR 9
+        grant/consume protocol: a single agent grants, we consume)."""
+        if not self.capacity_file:
+            return 0
+        room = ((self.max_replicas - len(self._live()))
+                if self.max_replicas is not None else None)
+        grant = read_capacity(self.capacity_file)
+        take = grant if room is None else min(grant, max(0, room))
+        if take <= 0:
+            return 0
+        consume_capacity(self.capacity_file, take)
+        return self.grow(take)
+
+    def _reap_draining(self) -> None:
+        for h in self._replicas:
+            if h.alive and h.draining and not h.engine.scheduler.has_work():
+                h.alive = False
+                h.engine.device_cache = None
+                self.stats["retired_replicas"] += 1
+
+    # -- the per-iteration surface ----------------------------------------
+
+    def step(self) -> List[Request]:
+        """One front-end iteration: fire armed ``replica_kill`` faults,
+        probe the capacity file, reap drained replicas, then advance
+        every live replica with work by one engine step. Returns the
+        requests finished this iteration (all replicas)."""
+        self._iters += 1
+        if faults.fire("replica_kill", self._iters):
+            self.kill_replica()
+        if self.capacity_file and self._iters % self.capacity_probe_every == 0:
+            self._probe_capacity()
+        self._reap_draining()
+        finished: List[Request] = []
+        for h in self._replicas:
+            if h.alive and h.engine.scheduler.has_work():
+                out = h.engine.step()
+                h.finished += len(out)
+                finished.extend(out)
+        self.stats["finished"] += len(finished)
+        self._sample_load()
+        return finished
+
+    def _sample_load(self) -> None:
+        live = self._live()
+        outs = [h.engine.outstanding_tokens for h in live]
+        total = sum(outs)
+        if outs and total > 0:
+            imb = max(outs) / (total / len(outs))
+            self.stats["imbalance_sum"] += imb
+            self.stats["imbalance_samples"] += 1
+            self.stats["imbalance_max"] = max(self.stats["imbalance_max"], imb)
+        now = self._now()
+        self._wait_samples.append(
+            max((h.engine.oldest_wait_age(now) for h in live), default=0.0))
+
+    def drain(self, max_iters: int = 10_000_000) -> List[Request]:
+        """Step until every replica is idle; returns everything finished
+        along the way."""
+        finished: List[Request] = []
+        while self.has_work():
+            finished.extend(self.step())
+            if self._iters >= max_iters:
+                raise RuntimeError(
+                    f"front-end did not drain in {max_iters} iters")
+        self._reap_draining()
+        return finished
+
+    # -- trace replay ------------------------------------------------------
+
+    def run(self, requests: Sequence[Request], *,
+            max_iters: int = 10_000_000) -> List[Request]:
+        """Replay an open-loop trace (same contract as ``ServingEngine.
+        run``): each request is SUBMITTED — routing + admission — when
+        the clock passes its ``arrival_time``; rejected requests simply
+        never finish (their ``SubmitResult`` is in ``submit_results``).
+        Returns the finished requests in input order."""
+        pending = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
+        t_start = self.clock()
+        if self.time_mode == "wall" and self._t0 is None:
+            self._t0 = t_start
+        done: List[Request] = []
+        while pending or self.has_work():
+            now = self._now()
+            while pending and pending[0].arrival_time <= now:
+                self.submit(pending.pop(0))
+            if not self.has_work():
+                if not pending:
+                    break
+                if self.time_mode == "wall":
+                    time.sleep(
+                        min(1e-3, max(0.0, pending[0].arrival_time - now)))
+                else:
+                    self._iters += 1   # idle tick advances the step clock
+                continue
+            done.extend(self.step())
+            if self._iters >= max_iters:
+                raise RuntimeError(
+                    f"front-end did not drain in {max_iters} iters")
+        self._reap_draining()
+        self.wall_elapsed = self.clock() - t_start
+        by_rid = {r.rid: r for r in done}
+        return [by_rid[r.rid] for r in requests if r.rid in by_rid]
+
+    # -- telemetry ---------------------------------------------------------
+
+    def summary(self) -> Dict[str, object]:
+        """Fleet-level accounting. Conservation invariants (tested):
+        ``accepted + rejected == submitted`` always, and ``finished ==
+        accepted`` once drained — failover moves a request, it never
+        duplicates or drops one."""
+        s: Dict[str, object] = {
+            k: v for k, v in self.stats.items()
+            if not k.startswith("imbalance_")}
+        live = self._live()
+        s["replicas_live"] = len(live)
+        s["replicas_total"] = len(self._replicas)
+        s["in_flight"] = int(self.stats["accepted"] - self.stats["finished"])
+        s["reject_rate"] = (
+            self.stats["rejected"] / max(1, self.stats["submitted"]))
+        s["queue_depth"] = sum(h.engine.queue_depth for h in live)
+        s["outstanding_tokens"] = sum(
+            h.engine.outstanding_tokens for h in live)
+        n = max(1, int(self.stats["imbalance_samples"]))
+        s["load_imbalance_mean"] = self.stats["imbalance_sum"] / n
+        s["load_imbalance_max"] = self.stats["imbalance_max"]
+        if self._wait_samples:
+            s["wait_age_p50"] = float(np.percentile(self._wait_samples, 50))
+            s["wait_age_p99"] = float(np.percentile(self._wait_samples, 99))
+        hit = sum(h.engine.scheduler.prefix_hit_tokens for h in self._replicas)
+        prompt = sum(h.engine.scheduler.prompt_tokens for h in self._replicas)
+        gen = sum(int(h.engine.stats["generated_tokens"])
+                  for h in self._replicas)
+        s["prompt_tokens"] = prompt
+        s["prefix_hit_tokens"] = hit
+        s["prefix_hit_rate"] = hit / max(1, prompt)
+        s["generated_tokens"] = gen
+        s["iters"] = self._iters
+        if self.wall_elapsed:
+            s["wall_s"] = self.wall_elapsed
+            s["tokens_per_s"] = gen / self.wall_elapsed
+        s["per_replica"] = [
+            {
+                "replica": h.rid,
+                "alive": h.alive,
+                "draining": h.draining,
+                "finished": h.finished,
+                "routed": dict(h.routed),
+                "generated_tokens": int(h.engine.stats["generated_tokens"]),
+                "prefix_hit_rate": (
+                    h.engine.scheduler.prefix_hit_tokens
+                    / max(1, h.engine.scheduler.prompt_tokens)),
+                "preemptions": h.engine.scheduler.n_preemptions,
+            }
+            for h in self._replicas
+        ]
+        return s

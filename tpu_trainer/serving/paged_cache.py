@@ -40,6 +40,25 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 
+def chained_block_digests(tokens: List[int], block_size: int) -> List[bytes]:
+    """Chained content digests of ``tokens``' FULL blocks: digest[i] =
+    blake2b(digest[i-1] + block i's token bytes), so equal digests imply
+    equal token prefixes up to and including block i. Shared by the
+    per-engine prefix index (``PagedKVCache.block_digests``) and the
+    multi-replica router's prefix-affinity key (``serving/frontend.py``)
+    — one hash function, so "the replica this prompt routes to" and "the
+    blocks that prompt can share" agree by construction."""
+    out: List[bytes] = []
+    parent = b""
+    for i in range(len(tokens) // block_size):
+        blk = np.asarray(
+            tokens[i * block_size:(i + 1) * block_size], np.int32)
+        parent = hashlib.blake2b(
+            parent + blk.tobytes(), digest_size=16).digest()
+        out.append(parent)
+    return out
+
+
 class BlockPool:
     """Refcounted free-list allocator over ``num_blocks`` pool blocks
     (id 0 reserved).
@@ -189,15 +208,7 @@ class PagedKVCache:
         """Chained content digests of ``tokens``' FULL blocks: digest[i]
         = blake2b(digest[i-1] + block i's token bytes), so equal digests
         imply equal token prefixes up to and including block i."""
-        bsz = self.block_size
-        out: List[bytes] = []
-        parent = b""
-        for i in range(len(tokens) // bsz):
-            blk = np.asarray(tokens[i * bsz:(i + 1) * bsz], np.int32)
-            parent = hashlib.blake2b(
-                parent + blk.tobytes(), digest_size=16).digest()
-            out.append(parent)
-        return out
+        return chained_block_digests(tokens, self.block_size)
 
     def prefix_lookup(self, prompt: List[int]) -> Tuple[List[int], int]:
         """Longest indexed prefix of ``prompt``, as ``(block_ids,

@@ -170,6 +170,55 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    # -- load signals (cheap, host-only — the multi-replica router's
+    # routing/admission inputs, and useful standalone telemetry) ----------
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for admission."""
+        return len(self.waiting)
+
+    @property
+    def oldest_waiting_arrival(self) -> Optional[float]:
+        """Earliest ``arrival_time`` in the waiting queue (None when
+        empty). Not simply ``waiting[0]`` — preemption requeues at the
+        front, so arrival order and queue order can differ."""
+        return min((r.arrival_time for r in self.waiting), default=None)
+
+    @property
+    def outstanding_tokens(self) -> int:
+        """Total token-steps of work still owed: remaining prefill plus
+        remaining decode for running requests; full context re-prefill
+        (prompt + generated-so-far) plus remaining decode for waiting
+        ones. The router's least-loaded signal — O(requests), no device
+        syncs."""
+        total = 0
+        for r in self.waiting:
+            total += r.context_len() + r.max_new_tokens - len(r.generated)
+        for r in self.running:
+            total += max(0, r.prefill_target - r.prefill_cursor)
+            total += r.max_new_tokens - len(r.generated)
+        return total
+
+    # -- drain/export (failover and shrink-teardown) -----------------------
+
+    def export_requests(self, *, waiting_only: bool = False) -> List[Request]:
+        """Strip every queued (and, unless ``waiting_only``, in-flight)
+        request out of this scheduler, reset to fresh-waiting state, for
+        resubmission elsewhere. Running requests are preempted first
+        (blocks released, cursors reset), so the export is also a clean
+        local teardown. Generated tokens, timestamps and sampling state
+        survive — re-admission re-prefills prompt + generated and the
+        (seed, token_index) sampling contract makes the resumed stream
+        token-identical, the preemption-resume argument. Returned in
+        (arrival_time, rid) order."""
+        if not waiting_only:
+            while self.running:
+                self.preempt(self.running[-1])
+        out = sorted(self.waiting, key=lambda r: (r.arrival_time, r.rid))
+        self.waiting.clear()
+        return out
+
     # -- the per-iteration decision ---------------------------------------
 
     def _admit(self) -> List[Request]:

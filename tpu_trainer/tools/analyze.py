@@ -20,7 +20,11 @@ latency, and decode-path tok/s — plus four elastic gates: ABSOLUTE caps
 on per-restart recovery seconds (``--recovery-tol``) and per-grow
 re-expansion seconds (``--grow-tol``), a restart-count-regression check,
 and a failure-to-regrow check (an ``--allow_grow`` run that lost hosts
-must finish back at its desired world) — and exits nonzero on any FAIL —
+must finish back at its desired world). ``frontend`` records
+(``serve_bench --replicas``, the multi-replica front-end) add two more:
+an ABSOLUTE admission-reject ceiling (``--reject-tol``) and a
+categorical affinity-vs-random prefix-hit-rate check over the same
+``--ab`` run. It exits nonzero on any FAIL —
 a CI-usable gate over the bench trajectory (exit 0 clean, 1 regression,
 2 unreadable/mis-schema'd input).
 
@@ -268,6 +272,41 @@ def summarize(records: List[dict]) -> dict:
             "spec_accept_rate", "spec_accept_hist",
             ) if s.get(k) is not None}
 
+    fronts = by_kind.get("frontend", [])
+    if fronts:
+        # serve_bench.py --replicas records (the multi-replica front-end,
+        # serving/frontend.py). Latest record wins for the summary line;
+        # the routing A/B is read from whichever record carries its own
+        # random baseline (serve_bench --ab annotates the policy lane),
+        # falling back to pairing this file's newest policy and random
+        # lanes.
+        f = fronts[-1]
+        report["frontend"] = {k: f.get(k) for k in (
+            "workload", "lane", "routing", "replicas", "replicas_live",
+            "tokens_per_s", "ttft_p99_s", "submitted", "accepted",
+            "rejected", "reject_rate", "prefix_hit_rate",
+            "load_imbalance_mean", "load_imbalance_max",
+            "failover_events", "failed_over_requests", "wait_age_p99_s",
+            ) if f.get(k) is not None}
+        ab = next((r for r in reversed(fronts)
+                   if r.get("random_prefix_hit_rate") is not None), None)
+        if ab is None:
+            aff = next((r for r in reversed(fronts)
+                        if r.get("routing") != "random"
+                        and r.get("lane") != "replica_kill"), None)
+            rnd = next((r for r in reversed(fronts)
+                        if r.get("routing") == "random"), None)
+            if aff is not None and rnd is not None:
+                ab = dict(aff,
+                          random_prefix_hit_rate=rnd.get("prefix_hit_rate"))
+        if ab is not None:
+            report["frontend"]["ab"] = {
+                "routing": ab.get("routing"),
+                "prefix_hit_rate": ab.get("prefix_hit_rate"),
+                "random_prefix_hit_rate": ab.get("random_prefix_hit_rate"),
+                "tok_s_vs_random": ab.get("tok_s_vs_random"),
+            }
+
     decodes = by_kind.get("decode", [])
     if decodes:
         rows = decodes[-1].get("rows") or []
@@ -506,6 +545,29 @@ def render(report: dict) -> List[str]:
                 f"/{s.get('spec_drafted') or 0} over"
                 f" {s.get('spec_steps') or 0} verify steps)"
                 f" hist {s.get('spec_accept_hist')}")
+    fe = report.get("frontend")
+    if fe:
+        lines.append(
+            f"frontend {fe.get('replicas_live')}/{fe.get('replicas')}"
+            f" replicas ({fe.get('routing')} routing, lane"
+            f" {fe.get('lane')}) | {_fmt(fe.get('tokens_per_s'), 0)} tok/s"
+            f" aggregate | TTFT p99"
+            f" {_fmt((fe.get('ttft_p99_s') or 0) * 1e3, 1)}ms")
+        lines.append(
+            f"frontend {fe.get('accepted')}/{fe.get('submitted')} accepted"
+            f" (reject rate {_fmt(fe.get('reject_rate'), 3)})"
+            f" | load imbalance mean {_fmt(fe.get('load_imbalance_mean'))}"
+            f" max {_fmt(fe.get('load_imbalance_max'))}"
+            f" | failovers {fe.get('failover_events') or 0}"
+            f" ({fe.get('failed_over_requests') or 0} reqs)")
+        ab = fe.get("ab")
+        if ab:
+            lines.append(
+                f"frontend A/B {ab.get('routing')} hit rate"
+                f" {_fmt(ab.get('prefix_hit_rate'))} vs random"
+                f" {_fmt(ab.get('random_prefix_hit_rate'))}"
+                + (f" | tok/s x{_fmt(ab.get('tok_s_vs_random'))}"
+                   if ab.get("tok_s_vs_random") is not None else ""))
     src = report.get("sources")
     if src:
         parts = "  ".join(
@@ -552,7 +614,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             pack_tol: float = 0.05,
             plan_tol: float = 0.30,
             moe_drop_tol: float = 0.0,
-            spec_accept_tol: float = 0.0) -> List[dict]:
+            spec_accept_tol: float = 0.0,
+            reject_tol: float = 0.05) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -612,6 +675,25 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
     the worst captured drop_frac exceeds ``moe_drop_tol``; SKIP for
     capacity-mode or non-MoE runs (drops there are a tuning choice, not a
     bug).
+
+    Two front-end gates cover multi-replica serving runs (``kind=
+    "frontend"`` records from ``serve_bench --replicas``):
+
+    - ``frontend_reject_rate`` is ABSOLUTE against a fixed ceiling:
+      the share of submitted requests shed at admission must stay under
+      ``reject_tol`` regardless of the baseline — backpressure is a
+      safety valve, and a valve that is open 20% of the time is an
+      undersized fleet (or a routing bug piling work on one replica),
+      not a healthy steady state. SKIP when the run has no frontend
+      records.
+    - ``frontend_affinity`` is categorical: in a routing A/B
+      (``serve_bench --ab`` stamps the policy lane's record with the
+      random lane's ``random_prefix_hit_rate``), the affinity policy's
+      aggregate prefix hit rate must not fall below the random-routing
+      baseline measured in the same run. Affinity routing exists only
+      to buy cache hits; losing to a coin flip means the key, the
+      rendezvous hash, or the spill threshold is broken. SKIP when the
+      record set carries no A/B pair.
     """
     def get(report, *keys):
         cur = report
@@ -829,6 +911,38 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "new": n_el["final_world"],
             "absolute": True,
         })
+
+    new_reject = get(new, "frontend", "reject_rate")
+    if new_reject is None:
+        verdicts.append({"metric": "frontend_reject_rate", "verdict": "SKIP",
+                         "base": get(base, "frontend", "reject_rate"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "frontend_reject_rate",
+            "verdict": "FAIL" if new_reject > reject_tol + eps else "PASS",
+            "base": get(base, "frontend", "reject_rate"),
+            "new": round(new_reject, 4),
+            "tolerance_frac": reject_tol,
+            "absolute": True,
+        })
+
+    # Affinity-vs-random A/B (both hit rates come from the SAME run's
+    # record set — see summarize — so this never compares across trees).
+    n_ab = get(new, "frontend", "ab") or {}
+    aff_hit = n_ab.get("prefix_hit_rate")
+    rnd_hit = n_ab.get("random_prefix_hit_rate")
+    if aff_hit is None or rnd_hit is None:
+        verdicts.append({"metric": "frontend_affinity", "verdict": "SKIP",
+                         "base": None, "new": aff_hit})
+    else:
+        verdicts.append({
+            "metric": "frontend_affinity",
+            "verdict": "FAIL" if aff_hit < rnd_hit - eps else "PASS",
+            "base": round(rnd_hit, 4),
+            "new": round(aff_hit, 4),
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -913,6 +1027,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "drafts per verify step falls below this floor "
                              "(default 0.0 — always passes); SKIP when the "
                              "new run served without a proposer")
+    parser.add_argument("--reject-tol", type=float, default=0.05,
+                        help="ABSOLUTE gate on front-end admission: FAIL "
+                             "if a multi-replica serving run rejected more "
+                             "than this fraction of submitted requests "
+                             "(default 0.05); SKIP when the run has no "
+                             "frontend records. The affinity-vs-random "
+                             "hit-rate gate needs no tolerance: affinity "
+                             "losing to random in the same --ab run is a "
+                             "categorical FAIL")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -938,7 +1061,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             recovery_tol=args.recovery_tol, grow_tol=args.grow_tol,
             pack_tol=args.pack_tol, plan_tol=args.plan_tol,
             moe_drop_tol=args.moe_drop_tol,
-            spec_accept_tol=args.spec_accept_tol)
+            spec_accept_tol=args.spec_accept_tol,
+            reject_tol=args.reject_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

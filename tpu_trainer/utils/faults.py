@@ -36,6 +36,11 @@ programmatically (tests) or from the ``--inject_fault`` debug flag:
   warning a real scheduler delivers before the kill. The trainer drains
   proactively: checkpoint at the next step boundary, deregister, exit
   clean — and the supervisor reforms before the simulated kill lands.
+- ``replica_kill@N``  — chaos lane, serving tier: the multi-replica
+  front-end (``serving/frontend.py``) marks one engine replica dead at
+  front-end iteration N (default: the highest-id live replica; override
+  with ``TPU_TRAINER_FAULT_REPLICA``). Its queued and in-flight requests
+  must fail over to the survivors and finish token-identically.
 - ``return_host@N``   — chaos lane: at step N rank 0 writes a capacity
   grant to the supervisor's capacity file (``TPU_TRAINER_CAPACITY_FILE``),
   simulating a preempted host coming back — the grow probe
@@ -68,7 +73,7 @@ from typing import List, Optional, Tuple
 KINDS = frozenset(
     {"nan_loss", "loss_spike", "kill", "kill_in_save", "truncate_meta",
      "corrupt_shard", "sigterm", "kill_host", "hang_host",
-     "preempt_notice", "return_host"}
+     "preempt_notice", "return_host", "replica_kill"}
 )
 
 # Kinds that act on :func:`target_host`'s rank(s) only.
