@@ -216,6 +216,18 @@ def main(argv=None) -> int:
                    choices=("affinity", "random", "least_loaded"),
                    help="front-end routing policy (--replicas); with --ab "
                         "the lanes become random vs this policy")
+    p.add_argument("--workers", type=int, default=0,
+                   help="route the trace through N CROSS-PROCESS worker "
+                        "replicas (the serving/worker.py RPC runtime) "
+                        "behind the same front-end; with --ab, lane A is "
+                        "the identical fleet in-process — the transport "
+                        "A/B on one trace, stamping per-request RPC "
+                        "overhead on the rpc record")
+    p.add_argument("--worker-kill", type=int, default=0,
+                   help="with --workers: add a lane that SIGKILLs one "
+                        "worker process at this front-end iteration "
+                        "(worker_kill fault) and proves cross-process "
+                        "failover drains")
     p.add_argument("--replica-kill", type=int, default=0,
                    help="with --replicas: add a lane that kills one "
                         "replica at this front-end iteration "
@@ -248,6 +260,14 @@ def main(argv=None) -> int:
                    help="seconds; > 0 gates p99 TPOT and exits 1 past it "
                         "(--smoke defaults this to 60)")
     args = p.parse_args(argv)
+
+    if args.workers > 0:
+        if args.replicas > 0 and args.replicas != args.workers:
+            p.error("--workers and --replicas are the same fleet size; "
+                    "give one of them")
+        # Worker lanes reuse the whole front-end lane machinery; the
+        # fleet size IS the replica count, just cross-process.
+        args.replicas = args.workers
 
     if args.smoke:
         args.requests = 16
@@ -672,7 +692,16 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     mid-run ``--replica-kill`` failover lane. Emits ``kind="frontend"``
     records; the drain gate checks the front-end's conservation invariant
     (every ACCEPTED request finished — rejects are backpressure, not
-    losses)."""
+    losses).
+
+    ``--workers N`` runs the SAME lanes cross-process (each replica a
+    ``serving/worker.py`` OS process behind the RPC socket): with
+    ``--ab`` lane A is the identical fleet in-process, and the rpc
+    record carries per-request RPC overhead — the per-rid
+    submit-to-first-token delta vs the in-process lane on the same
+    trace — as ``rpc_overhead_p50_s``/``rpc_overhead_p99_s``.
+    ``--worker-kill I`` adds a lane that SIGKILLs a real worker process
+    at front-end iteration I (the ``worker_kill`` fault)."""
     import json
 
     import numpy as np
@@ -682,24 +711,48 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     from tpu_trainer.utils import faults
     from tpu_trainer.utils.logging import SCHEMA_VERSION
 
-    def build(routing):
+    engine_kwargs = dict(
+        max_batch=args.concurrency, block_size=args.block_size,
+        num_blocks=args.num_blocks or None, kv_int8=args.kv_int8,
+        attention=args.attention,
+        prefill_chunk_tokens=args.prefill_chunk or None,
+        prefix_cache=True,
+    )
+    supervisors = []
+
+    def make_supervisor():
+        from tpu_trainer.serving.remote import WorkerSupervisor
+
+        sup = WorkerSupervisor(params, cfg, engine_kwargs=engine_kwargs)
+        sup.prewarm(args.replicas)
+        supervisors.append(sup)
+        return sup
+
+    def build(routing, sup=None):
         return ServingFrontend(
             params, cfg, replicas=args.replicas, routing=routing,
-            max_batch=args.concurrency, block_size=args.block_size,
-            num_blocks=args.num_blocks or None, kv_int8=args.kv_int8,
-            attention=args.attention,
-            prefill_chunk_tokens=args.prefill_chunk or None,
-            prefix_cache=True,
             max_queue_depth=args.max_queue or max(args.requests, 1),
             wait_watermark=args.wait_watermark or None,
-            seed=args.seed,
+            seed=args.seed, replica_factory=sup,
+            **engine_kwargs,
         )
 
-    def run_lane(lane, routing, kill_step=0):
-        build(routing).run(make_trace())   # warm-up: compiles every shape
-        fe = build(routing)
+    def run_lane(lane, routing, kill_step=0, transport="inproc"):
+        if transport == "rpc":
+            # Warm-up compiles inside the worker PROCESSES, so they must
+            # survive into the timed run: reset() rebuilds each worker's
+            # engine in place (per-config jit cache kept) and the timed
+            # front-end adopts the warm processes from the pool.
+            sup = make_supervisor()
+            build(routing, sup).run(make_trace())
+            sup.reset()
+            fe = build(routing, sup)
+        else:
+            build(routing).run(make_trace())   # warm-up: compiles shapes
+            fe = build(routing)
         if kill_step > 0:
-            with faults.plan(f"replica_kill@{kill_step}"):
+            kind = "worker_kill" if transport == "rpc" else "replica_kill"
+            with faults.plan(f"{kind}@{kill_step}"):
                 finished = fe.run(make_trace())
         else:
             finished = fe.run(make_trace())
@@ -712,6 +765,9 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             "workload": workload,
             "lane": lane,
             "routing": routing,
+            "transport": s["transport"],
+            "workers": args.workers,
+            "worker_deaths": int(s["worker_deaths"]),
             "replicas": args.replicas,
             "replicas_live": int(s["replicas_live"]),
             "n_requests": args.requests,
@@ -751,24 +807,59 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
                     float(np.percentile(series, 50)), 5)
                 record[f"{name}_p99_s"] = round(
                     float(np.percentile(series, 99)), 5)
-        return record, drained
+        ttfts = {r.rid: r.first_token_at - r.arrival_time
+                 for r in finished if r.first_token_at is not None}
+        return record, drained, ttfts
 
-    lanes = []
-    if args.ab:
+    workers_mode = args.workers > 0
+    if workers_mode:
+        # Transport A/B: the same trace, same routing, same fleet size —
+        # in-process vs one-OS-process-per-replica over RPC.
+        lanes = [("inproc", args.routing, 0, "inproc")] if args.ab else []
+        lanes.append(("rpc", args.routing, 0, "rpc"))
+        if args.worker_kill > 0:
+            lanes.append(
+                ("worker_kill", args.routing, args.worker_kill, "rpc"))
+    elif args.ab:
         b_routing = args.routing if args.routing != "random" else "affinity"
-        lanes = [("random", "random", 0), (b_routing, b_routing, 0)]
+        lanes = [("random", "random", 0, "inproc"),
+                 (b_routing, b_routing, 0, "inproc")]
     else:
-        lanes = [(args.routing, args.routing, 0)]
-    if args.replica_kill > 0:
-        lanes.append(("replica_kill", args.routing, args.replica_kill))
+        lanes = [(args.routing, args.routing, 0, "inproc")]
+    if args.replica_kill > 0 and not workers_mode:
+        lanes.append(("replica_kill", args.routing, args.replica_kill,
+                      "inproc"))
 
-    records, all_drained = [], True
-    for lane, routing, kill in lanes:
-        rec, drained = run_lane(lane, routing, kill)
-        all_drained = all_drained and drained
-        records.append(rec)
+    records, all_drained, lane_ttfts = [], True, {}
+    try:
+        for lane, routing, kill, transport in lanes:
+            rec, drained, ttfts = run_lane(lane, routing, kill, transport)
+            all_drained = all_drained and drained
+            records.append(rec)
+            lane_ttfts[lane] = ttfts
+    finally:
+        for sup in supervisors:
+            sup.close()
 
-    if args.ab and len(records) >= 2:
+    if workers_mode and args.ab and len(records) >= 2:
+        a = next(r for r in records if r["transport"] == "inproc")
+        b = next(r for r in records if r["transport"] == "rpc")
+        # Per-request RPC overhead: the submit-to-first-token delta of
+        # the SAME rid on the SAME trace, rpc minus in-process — what
+        # the wire (framing + socket + worker dispatch) actually costs,
+        # with queueing/compile effects cancelled by identical routing.
+        deltas = [lane_ttfts[b["lane"]][rid] - t
+                  for rid, t in lane_ttfts[a["lane"]].items()
+                  if rid in lane_ttfts[b["lane"]]]
+        if deltas:
+            b["rpc_overhead_p50_s"] = round(
+                float(np.percentile(deltas, 50)), 5)
+            b["rpc_overhead_p99_s"] = round(
+                float(np.percentile(deltas, 99)), 5)
+        b["inproc_tokens_per_s"] = a["tokens_per_s"]
+        b["tok_s_vs_inproc"] = round(
+            b["tokens_per_s"] / max(a["tokens_per_s"], 1e-9), 3)
+    elif args.ab and len(records) >= 2:
         a, b = records[0], records[1]
         # The categorical affinity-vs-random gate (tools/analyze.py)
         # reads both hit rates out of the SAME A/B record.
@@ -779,7 +870,17 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     for rec in records:
         _print_frontend_record(rec)
         print(json.dumps(rec), flush=True)
-    if args.ab and len(records) >= 2:
+    if workers_mode:
+        if args.ab and len(records) >= 2:
+            b = next(r for r in records if r["transport"] == "rpc")
+            print(f"A/B     rpc vs in-process: tok/s "
+                  f"x{b['tok_s_vs_inproc']:.2f}, RPC overhead p50 "
+                  f"{(b.get('rpc_overhead_p50_s') or 0) * 1e3:.1f} ms "
+                  f"p99 {(b.get('rpc_overhead_p99_s') or 0) * 1e3:.1f} ms",
+                  flush=True)
+        if args.update_md:
+            update_workers_md(workload, records, args)
+    elif args.ab and len(records) >= 2:
         a, b = records[0], records[1]
         print(f"A/B     {b['lane']} vs random routing: prefix hit rate "
               f"{b['prefix_hit_rate']:.2f} vs {a['prefix_hit_rate']:.2f}, "
@@ -811,6 +912,14 @@ def _print_frontend_record(r) -> None:
           f"{r['replicas']} replicas ({r['replicas_live']} live, routing "
           f"{r['routing']}), {r['accepted']}/{r['submitted']} accepted, "
           f"{r['generated_tokens']} tokens, {r['wall_s']:.2f}s", flush=True)
+    if r.get("transport") == "rpc":
+        line = (f"rpc     {r['workers']} worker processes, "
+                f"{r['worker_deaths']} deaths")
+        if r.get("rpc_overhead_p99_s") is not None:
+            line += (f", RPC overhead p50 "
+                     f"{r['rpc_overhead_p50_s'] * 1e3:.1f} ms p99 "
+                     f"{r['rpc_overhead_p99_s'] * 1e3:.1f} ms")
+        print(line, flush=True)
     if "ttft_p50_s" in r:
         print(f"TTFT    p50 {r['ttft_p50_s'] * 1e3:8.1f} ms   "
               f"p99 {r['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
@@ -875,6 +984,64 @@ def update_frontend_md(workload, records, args) -> None:
     with open(_RESULTS_MD, "w") as f:
         f.write(text)
     print(f"wrote multi-replica serving table to {_RESULTS_MD}",
+          file=sys.stderr)
+
+
+def update_workers_md(workload, records, args) -> None:
+    """Splice the cross-process (transport A/B) lane table into
+    benchmarks/results.md (marker block ``serving-workers``)."""
+    start = "<!-- serving-workers:start -->"
+    end = "<!-- serving-workers:end -->"
+    m = records[0]["model"]
+    header = (
+        f"`python benchmarks/serve_bench.py --workload {workload} "
+        f"--workers {records[0]['replicas']} --ab"
+        + (f" --worker-kill {args.worker_kill}" if args.worker_kill else "")
+        + f"` — hidden {m['hidden']}, layers {m['layers']}, "
+        f"{records[0]['n_requests']} reqs @ concurrency "
+        f"{records[0]['concurrency']} per replica, block "
+        f"{records[0]['block_size']} ({time.strftime('%Y-%m-%d')}). "
+        f"Lane A is the identical fleet in-process; RPC overhead is the "
+        f"per-request submit-to-first-token delta vs that lane on the "
+        f"same trace.\n\n"
+    )
+    lines = [
+        "| Lane | transport | workers | tok/s | TTFT p99 (ms) "
+        "| RPC overhead p50/p99 (ms) | worker deaths | failovers |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        if r.get("rpc_overhead_p99_s") is not None:
+            ovh = (f"{r['rpc_overhead_p50_s'] * 1e3:.1f} / "
+                   f"{r['rpc_overhead_p99_s'] * 1e3:.1f}")
+        else:
+            ovh = "-"
+        n_workers = r["workers"] if r.get("transport") == "rpc" else 0
+        lines.append(
+            f"| {r['lane']} | {r.get('transport', 'inproc')} "
+            f"| {n_workers or '-'} "
+            f"| {r['tokens_per_s']:,.0f} "
+            f"| {(r.get('ttft_p99_s') or 0) * 1e3:.1f} "
+            f"| {ovh} | {r['worker_deaths']} | {r['failover_events']} |"
+        )
+    block = f"{start}\n{header}" + "\n".join(lines) + f"\n{end}"
+    section_head = "## Cross-process serving"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if start in text:
+        text = text.split(start)[0] + block + text.split(end)[1]
+    elif section_head in text:
+        text = text.replace(f"{section_head}\n",
+                            f"{section_head}\n\n{block}\n", 1)
+    elif "\n## Multi-replica serving" in text:
+        text = text.replace(
+            "\n## Multi-replica serving",
+            f"\n{section_head}\n\n{block}\n\n## Multi-replica serving", 1)
+    else:
+        text += f"\n{section_head}\n\n{block}\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote cross-process serving table to {_RESULTS_MD}",
           file=sys.stderr)
 
 

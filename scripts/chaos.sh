@@ -118,4 +118,17 @@ python benchmarks/serve_bench.py --smoke --workload shared_prefix \
 python -m tpu_trainer.tools.analyze "$SERVE_OUT" \
   --compare "$SERVE_OUT" --reject-tol 0.0
 
+# 8. Cross-process serving (serving/worker.py): the same drill with each
+#    replica a real OS process behind the RPC socket — a worker is
+#    SIGKILL'd mid-bench, death detected by exit code, mirrors fail the
+#    work over bit-identically. Lane A is the identical fleet in-process;
+#    analyze gates the per-request RPC overhead measured between them.
+WORKER_OUT="$OUT/worker_kill.jsonl"
+rm -f "$WORKER_OUT"
+echo "== chaos: worker_kill (cross-process serving) =="
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --workers 2 --ab --worker-kill 6 --out "$WORKER_OUT"
+python -m tpu_trainer.tools.analyze "$WORKER_OUT" \
+  --compare "$WORKER_OUT" --reject-tol 0.0 --rpc-overhead-tol 5.0
+
 echo "chaos: full matrix clean ($OUT)"

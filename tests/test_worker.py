@@ -1,0 +1,441 @@
+"""Cross-process serving worker tests (ISSUE 15): wire protocol,
+RemoteReplica mirrors, worker supervision, and SIGKILL failover.
+
+Tier-1 (not in conftest's _SLOW_MODULES), all on CPU in deterministic
+``time_mode="steps"``. The load-bearing assertions:
+
+- every RPC message survives the wire losslessly: frames round-trip,
+  ``Request`` (sampling state incl. ``top_p``, generated tokens,
+  timestamps, cursors) and export payloads re-materialise exactly —
+  the cross-process preemption-resume contract;
+- a torn frame poisons only the CONNECTION: the worker closes that
+  socket and keeps serving, the client raises instead of wedging;
+- greedy AND sampled streams through N real worker processes are
+  BIT-IDENTICAL to an undisturbed single-engine run, and token
+  timestamps match the in-process front-end exactly — one front-end
+  clock domain spans the fleet (every timestamp an integral iteration
+  number in ``steps`` mode);
+- a real SIGKILL mid-run is detected by exit code and the mirrors fail
+  the dead worker's work over bit-identically (finished == accepted);
+- death detection: exit codes and heartbeat flatlines each reported
+  exactly once; capacity grants spawn real processes and shrink drains
+  them.
+
+One module-scoped supervisor (two prewarmed workers, ``reset()``
+between tests) keeps the process-spawn cost to roughly one fleet
+build. The ``@pytest.mark.slow`` chaos lane drives the same kill
+through serve_bench's ``--workers --worker-kill`` path and the analyze
+``--rpc-overhead-tol`` gate, mirroring scripts/chaos.sh.
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT
+from tpu_trainer.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+    WorkerSupervisor,
+)
+from tpu_trainer.serving import remote
+from tpu_trainer.serving.remote import (
+    FrameError,
+    MAX_FRAME_BYTES,
+    ReplicaDied,
+    WorkerHandle,
+    encode_frame,
+    load_params_npz,
+    recv_frame,
+    request_apply_wire,
+    request_from_wire,
+    request_to_wire,
+    save_params_npz,
+    send_frame,
+)
+from tpu_trainer.utils import faults
+from tpu_trainer.utils.preemption import grant_capacity, read_capacity
+
+# Same tiny model as test_frontend.py ON PURPOSE: within one pytest
+# process the in-process jit cache is already warm when this module
+# runs, so only the worker subprocesses pay a compile.
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+                dtype="float32", param_dtype="float32")
+BLOCK = 8
+ENGINE_KW = dict(block_size=BLOCK, attention="reference",
+                 prefix_cache=True, max_batch=4)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def sup(params):
+    s = WorkerSupervisor(params, CFG, engine_kwargs=ENGINE_KW)
+    s.prewarm(2)
+    yield s
+    s.close()
+
+
+def _mixed_requests(n=8, max_new=6, seed=0):
+    """Shared-prefix trace mixing greedy and top-p sampled requests —
+    a fresh RandomState per call, so two calls build identical traces
+    (the bit-identity tests compare across separate runs)."""
+    rs = np.random.RandomState(seed)
+    prefix = rs.randint(1, CFG.vocab_size, size=2 * BLOCK).tolist()
+    reqs = []
+    for i in range(n):
+        tail = rs.randint(1, CFG.vocab_size,
+                          size=4 + (i % 2) * 8).tolist()
+        temp = 0.0 if i % 2 == 0 else 0.8
+        reqs.append(Request(
+            rid=i, prompt=prefix + tail, max_new_tokens=max_new,
+            sampling=SamplingParams(temperature=temp, top_p=0.9,
+                                    seed=100 + i),
+            arrival_time=0.0))
+    return reqs
+
+
+# --- wire protocol (pure python, no processes) -----------------------------
+
+class TestFraming:
+    def test_frames_round_trip_in_order(self):
+        a, b = socket.socketpair()
+        try:
+            msgs = [{"id": 1, "method": "ping"},
+                    {"id": 2, "ok": True, "result": {"deltas": [],
+                                                     "load": {"q": 0}}},
+                    {"unicode": "héllo", "nested": [1, [2, {"x": None}]]}]
+            for m in msgs:
+                send_frame(a, m)
+            assert [recv_frame(b) for _ in msgs] == msgs
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_between_frames_is_none(self):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, {"id": 1})
+            a.close()
+            assert recv_frame(b) == {"id": 1}
+            assert recv_frame(b) is None
+        finally:
+            b.close()
+
+    @pytest.mark.parametrize("poison", [
+        b"\x00\x00",                              # torn header
+        struct.pack(">I", 0),                     # zero length
+        struct.pack(">I", MAX_FRAME_BYTES + 1),   # oversized length
+        struct.pack(">I", 100) + b"short",        # torn body
+        struct.pack(">I", 4) + b"notj",           # non-JSON body
+        struct.pack(">I", 4) + b"\xff\xfe\x00\x01",   # non-UTF-8 body
+    ])
+    def test_torn_frame_raises_frame_error(self, poison):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(poison)
+            a.close()
+            with pytest.raises(FrameError):
+                recv_frame(b)
+        finally:
+            b.close()
+
+    def test_oversized_outgoing_frame_refused(self):
+        with pytest.raises(FrameError, match="exceeds max"):
+            encode_frame({"blob": "x" * (MAX_FRAME_BYTES + 1)})
+
+    def test_rpc_maps_worker_value_error_and_bad_id(self):
+        a, b = socket.socketpair()
+        try:
+            # Pre-buffer the responses: rpc() sends, then reads what is
+            # already queued on the full-duplex pair.
+            send_frame(b, {"id": 1, "ok": False,
+                           "error": {"type": "ValueError", "msg": "nope"}})
+            with pytest.raises(ValueError, match="nope"):
+                remote.rpc(a, 1, "submit", {})
+            send_frame(b, {"id": 99, "ok": True, "result": {}})
+            with pytest.raises(ReplicaDied, match="response id"):
+                remote.rpc(a, 2, "ping", {})
+            b.close()
+            with pytest.raises(ReplicaDied):
+                remote.rpc(a, 3, "ping", {})
+        finally:
+            a.close()
+
+
+class TestRequestWire:
+    def _request(self):
+        req = Request(rid=7, prompt=[3, 1, 4, 1, 5, 9], max_new_tokens=12,
+                      sampling=SamplingParams(temperature=0.7, top_k=11,
+                                              top_p=0.85, seed=42),
+                      arrival_time=2.0, eos_id=5)
+        req.generated = [8, 2, 8]
+        req.token_times = [3.0, 4.0, 5.0]
+        req.status = "running"
+        req.slot = 2
+        req.preemptions = 1
+        req.first_token_at = 3.0
+        req.prefill_cursor = 6
+        req.prefill_target = 6
+        req.prefix_hit_tokens = 8
+        req.spec_drafted, req.spec_accepted, req.spec_steps = 4, 3, 2
+        req._blocks_registered = 1
+        return req
+
+    def test_request_round_trips_losslessly(self):
+        req = self._request()
+        # Through real JSON, exactly like the socket path.
+        back = request_from_wire(json.loads(json.dumps(request_to_wire(req))))
+        assert back.rid == req.rid and back.prompt == req.prompt
+        assert back.sampling == req.sampling        # incl. top_p
+        assert back.generated == req.generated
+        assert back.token_times == req.token_times
+        assert back.eos_id == req.eos_id
+        assert back.arrival_time == req.arrival_time
+        assert back._blocks_registered == req._blocks_registered
+        for f in remote._RUNTIME_FIELDS:
+            assert getattr(back, f) == getattr(req, f), f
+
+    def test_apply_wire_syncs_runtime_state_onto_mirror(self):
+        req = self._request()
+        mirror = Request(rid=7, prompt=list(req.prompt), max_new_tokens=12,
+                         sampling=req.sampling, arrival_time=2.0, eos_id=5)
+        request_apply_wire(mirror, request_to_wire(req))
+        assert mirror.generated == req.generated
+        assert mirror.status == "running" and mirror.preemptions == 1
+        assert mirror.prefix_hit_tokens == 8
+
+    def test_params_npz_round_trips_nested_tree(self, tmp_path):
+        tree = {"wte": {"embedding": np.arange(6, dtype=np.float32)
+                        .reshape(2, 3)},
+                "h_0": {"attn": {"kernel": np.ones((2, 2), np.float32)},
+                        "scale": np.float32(2.5)}}
+        path = str(tmp_path / "p.npz")
+        save_params_npz(path, tree)
+        back = load_params_npz(path)
+        np.testing.assert_array_equal(back["wte"]["embedding"],
+                                      tree["wte"]["embedding"])
+        np.testing.assert_array_equal(back["h_0"]["attn"]["kernel"],
+                                      tree["h_0"]["attn"]["kernel"])
+        assert float(back["h_0"]["scale"]) == 2.5
+
+
+# --- death detection without real processes --------------------------------
+
+class _FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.pid = 999999
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+class TestDeathDetection:
+    def test_exit_code_death_reported_exactly_once(self, tmp_path):
+        sup = WorkerSupervisor(None, None, run_dir=str(tmp_path / "r"))
+        sup._handles[0] = WorkerHandle(worker_id=0, proc=_FakeProc(rc=137),
+                                       sock=None)
+        sup._handles[1] = WorkerHandle(worker_id=1, proc=_FakeProc(),
+                                       sock=None)
+        assert sup.poll_deaths() == [0]
+        assert sup.poll_deaths() == []          # reported once
+        sup._handles[1].retired = True          # deliberate shutdowns
+        sup._handles[1].proc.rc = 0             # are never deaths
+        assert sup.poll_deaths() == []
+
+    def test_heartbeat_flatline_detected_and_settled(self, tmp_path):
+        sup = WorkerSupervisor(None, None, run_dir=str(tmp_path / "r"),
+                               heartbeat_timeout_s=0.5)
+        proc = _FakeProc()                      # alive but wedged
+        sup._handles[3] = WorkerHandle(worker_id=3, proc=proc, sock=None)
+        beat = os.path.join(sup.heartbeat_dir, "heartbeat_host00003.jsonl")
+        with open(beat, "w") as f:
+            f.write(json.dumps({"kind": "heartbeat",
+                                "unix": time.time() - 60}) + "\n")
+        assert sup.poll_deaths() == [3]
+        assert proc.rc is not None              # settled with a kill
+        assert sup.poll_deaths() == []
+
+    def test_fresh_heartbeat_is_not_a_death(self, tmp_path):
+        sup = WorkerSupervisor(None, None, run_dir=str(tmp_path / "r"),
+                               heartbeat_timeout_s=30.0)
+        sup._handles[0] = WorkerHandle(worker_id=0, proc=_FakeProc(),
+                                       sock=None)
+        beat = os.path.join(sup.heartbeat_dir, "heartbeat_host00000.jsonl")
+        with open(beat, "w") as f:
+            f.write(json.dumps({"kind": "heartbeat",
+                                "unix": time.time()}) + "\n")
+        assert sup.poll_deaths() == []
+
+
+# --- the real fleet: bit-identity, failover, resize ------------------------
+
+class TestWorkerFleet:
+    """Ordered: each test leaves the module supervisor's pool warm for
+    the next (reset() keeps processes, rebuilds engines)."""
+
+    def _fe(self, params, sup, **kw):
+        kw.setdefault("replicas", 2)
+        kw.setdefault("routing", "affinity")
+        kw.setdefault("time_mode", "steps")
+        return ServingFrontend(params, CFG, replica_factory=sup, **kw)
+
+    def test_streams_bit_identical_and_one_clock_domain(self, params, sup):
+        eng = ServingEngine(params, CFG, **ENGINE_KW)
+        want = {r.rid: list(r.generated)
+                for r in eng.run(_mixed_requests(), time_mode="steps")}
+
+        fe_in = ServingFrontend(params, CFG, replicas=2, routing="affinity",
+                                time_mode="steps", **ENGINE_KW)
+        fin_in = fe_in.run(_mixed_requests())
+        assert {r.rid: list(r.generated) for r in fin_in} == want
+        in_times = {r.rid: list(r.token_times) for r in fin_in}
+
+        fe = self._fe(params, sup)
+        fin = fe.run(_mixed_requests())
+        s = fe.summary()
+        assert {r.rid: list(r.generated) for r in fin} == want
+        # One clock domain: the workers' timestamps ARE the front-end's
+        # iteration numbers — equal to the in-process front-end on the
+        # same topology, and integral in steps mode.
+        got_times = {r.rid: list(r.token_times) for r in fin}
+        assert got_times == in_times
+        assert all(t == float(int(t))
+                   for ts in got_times.values() for t in ts)
+        assert s["transport"] == "rpc"
+        assert s["finished"] == s["accepted"] == len(fin)
+        assert s["worker_deaths"] == 0
+        sup.reset()
+
+    def test_torn_frame_closes_connection_not_worker(self, sup):
+        h = sup._pool[0]
+        path = os.path.join(sup.run_dir, f"w{h.worker_id}.sock")
+        # Free the worker's single serving loop, then poison it twice.
+        h.sock.close()
+        h.sock = None
+        try:
+            for poison in (struct.pack(">I", MAX_FRAME_BYTES + 1) + b"x",
+                           struct.pack(">I", 4) + b"notj"):
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(30.0)
+                s.connect(path)
+                s.sendall(poison)
+                # The worker closes the poisoned connection — as a clean
+                # FIN or, when it closed with bytes still unread, a RST.
+                try:
+                    assert s.recv(1) == b""
+                except ConnectionResetError:
+                    pass
+                s.close()
+        finally:
+            # Always hand a live connection back: later tests share this
+            # pooled handle and must not inherit a dead one.
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(120.0)
+            s.connect(path)
+            h.sock = s
+        # The process survived: the fresh connection serves normally.
+        assert remote.rpc(s, 1, "ping", {}) == {}
+        hello = remote.rpc(s, 2, "hello", {})
+        assert hello["pid"] == h.pid
+
+    def test_sigkill_failover_streams_bit_identical(self, params, sup,
+                                                    monkeypatch):
+        eng = ServingEngine(params, CFG, **ENGINE_KW)
+        want = {r.rid: list(r.generated)
+                for r in eng.run(_mixed_requests(), time_mode="steps")}
+
+        fe = self._fe(params, sup)
+        # Pin the victim to the replica that owns the shared prefix, so
+        # the kill really strands queued AND in-flight work.
+        victim = fe._rendezvous(
+            fe._affinity_key(_mixed_requests()[0].prompt), fe._live()).rid
+        monkeypatch.setenv("TPU_TRAINER_FAULT_REPLICA", str(victim))
+        with faults.plan("worker_kill@3"):
+            fin = fe.run(_mixed_requests())
+        s = fe.summary()
+        assert {r.rid: list(r.generated) for r in fin} == want
+        assert s["worker_deaths"] == 1
+        assert s["failover_events"] == 1
+        assert s["failed_over_requests"] >= 1
+        assert s["replicas_live"] == 1
+        assert s["finished"] == s["accepted"] == len(fin)
+        assert sup.live_worker_count() == 1     # the process is really gone
+        sup.reset()
+
+    @pytest.mark.slow   # real process spawn+drain; tier-1 budget is tight
+    def test_capacity_grant_spawns_and_shrink_drains_processes(
+            self, params, sup, tmp_path):
+        cap = str(tmp_path / "capacity.json")
+        fe = self._fe(params, sup, replicas=1, capacity_file=cap,
+                      max_replicas=2, capacity_probe_every=1)
+        spawned_before = sup._spawned
+        grant_capacity(cap, 1)
+        for r in _mixed_requests(6):
+            assert fe.submit(r).accepted
+        fin = fe.drain()
+        s = fe.summary()
+        assert len(fin) == 6 and s["finished"] == s["accepted"]
+        assert s["replicas_live"] == 2 and s["grows"] == 1
+        assert read_capacity(cap) == 0
+        # The grow was a REAL process: the pool was empty, so the
+        # supervisor had to launch a new worker.
+        assert sup._spawned == spawned_before + 1
+        assert sup.live_worker_count() == 2
+
+        fe.shrink(1)
+        fe.drain()
+        s = fe.summary()
+        assert s["replicas_live"] == 1 and s["retired_replicas"] == 1
+        assert sup.live_worker_count() == 1     # drained worker torn down
+        sup.reset()
+
+
+# --- the chaos lane (serve_bench --workers + analyze gates) ----------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestWorkerKillChaosLane:
+    def test_bench_workers_lane_and_analyze_gates(self, tmp_path):
+        # Transport A/B plus a real SIGKILL mid-bench: the bench's drain
+        # gate asserts every ACCEPTED request finished across processes,
+        # and analyze's absolute RPC-overhead gate passes on the run's
+        # own records (self-compare, like scripts/chaos.sh lane 8).
+        sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+        try:
+            import serve_bench
+        finally:
+            sys.path.pop(0)
+        out = str(tmp_path / "workers.jsonl")
+        assert serve_bench.main(
+            ["--smoke", "--workload", "shared_prefix", "--workers", "2",
+             "--ab", "--worker-kill", "6", "--out", out]) == 0
+        from tpu_trainer.tools.analyze import main as analyze_main
+        assert analyze_main(
+            [out, "--compare", out, "--reject-tol", "0.0",
+             "--rpc-overhead-tol", "5.0"]) == 0

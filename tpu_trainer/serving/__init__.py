@@ -27,12 +27,25 @@ a *request stream* —
   index, rendezvous-hashed over the live set), bounded queues with
   reject-at-submit backpressure, replica failover with token-identical
   resume, and capacity-file driven grow/shrink.
+- ``remote`` / ``worker`` — cross-process replicas: ``worker`` runs one
+  engine per OS process behind a length-prefixed JSON RPC socket;
+  ``remote`` provides the drop-in ``RemoteReplica`` adapter and the
+  ``WorkerSupervisor`` (heartbeat + exit-code death detection, real
+  ``SIGKILL`` drills) that plugs into ``ServingFrontend`` as its
+  ``replica_factory`` — same routing/admission/failover logic, one
+  front-end clock domain spanning the process fleet.
 """
 
 from tpu_trainer.serving.engine import ServingEngine, poisson_trace  # noqa: F401
 from tpu_trainer.serving.frontend import (  # noqa: F401
+    LocalReplica,
     ServingFrontend,
     SubmitResult,
+)
+from tpu_trainer.serving.remote import (  # noqa: F401
+    RemoteReplica,
+    ReplicaDied,
+    WorkerSupervisor,
 )
 from tpu_trainer.serving.paged_cache import BlockPool, PagedKVCache  # noqa: F401
 from tpu_trainer.serving.scheduler import (  # noqa: F401

@@ -53,6 +53,19 @@ Time: the front-end owns one clock domain shared by every replica
 epoch), so arrival times, wait ages, and token timestamps are all
 comparable across replicas — in seconds (``time_mode="wall"``) or
 front-end iterations (``"steps"``, fully deterministic for tests).
+
+Replicas are pluggable (``replica_factory``): the default builds
+in-process engines wrapped in ``LocalReplica``; passing a
+``serving.remote.WorkerSupervisor`` instead puts each replica in its
+own OS process behind the ``serving/worker.py`` RPC loop — same
+routing/admission/failover logic, and the same clock domain (every step
+RPC ships the front-end's ``now``, so ``steps`` mode stays
+deterministic fleet-wide). Worker deaths (SIGKILL exit codes or
+heartbeat flatlines, the ``worker_kill`` fault) are polled each step
+and drive the same ``kill_replica`` failover as ``replica_kill`` —
+dead-worker state is reconstructed from the front-end-side request
+mirrors, so queued AND in-flight requests resume bit-identically on
+the survivors.
 """
 
 from __future__ import annotations
@@ -68,6 +81,7 @@ import numpy as np
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.serving.engine import ServingEngine
 from tpu_trainer.serving.paged_cache import chained_block_digests
+from tpu_trainer.serving.remote import ReplicaDied
 from tpu_trainer.serving.scheduler import Request
 from tpu_trainer.utils import faults
 from tpu_trainer.utils.preemption import consume_capacity, read_capacity
@@ -92,12 +106,72 @@ class SubmitResult:
     oldest_wait: float = 0.0
 
 
+class LocalReplica:
+    """In-process replica adapter: the narrow engine surface the
+    front-end actually consumes, shared verbatim with
+    ``serving.remote.RemoteReplica`` so a worker process is a drop-in.
+    Anything the front-end wants from a replica goes through here —
+    submit, step, load counters, export, release — never through
+    engine internals directly."""
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+
+    def submit(self, req: Request) -> None:
+        self.engine.scheduler.add(req)
+
+    def step(self) -> List[Request]:
+        return self.engine.step()
+
+    def has_work(self) -> bool:
+        return self.engine.scheduler.has_work()
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth
+
+    @property
+    def outstanding_tokens(self) -> int:
+        return self.engine.outstanding_tokens
+
+    def oldest_wait_age(self, now: float) -> float:
+        return self.engine.oldest_wait_age(now)
+
+    def export_requests(self, *, waiting_only: bool = False) -> List[Request]:
+        return self.engine.export_requests(waiting_only=waiting_only)
+
+    def release(self) -> None:
+        self.engine.device_cache = None   # drop the KV pools
+
+    @property
+    def block_size(self) -> int:
+        return self.engine.cache_state.block_size
+
+    @property
+    def generated_tokens(self) -> int:
+        return int(self.engine.stats["generated_tokens"])
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return self.engine.scheduler.prefix_hit_tokens
+
+    @property
+    def prompt_tokens(self) -> int:
+        return self.engine.scheduler.prompt_tokens
+
+    @property
+    def n_preemptions(self) -> int:
+        return self.engine.scheduler.n_preemptions
+
+
 @dataclasses.dataclass
 class _Replica:
-    """One engine replica plus its front-end bookkeeping."""
+    """One replica adapter (local or remote) plus its front-end
+    bookkeeping. The attribute keeps the name ``engine`` — it holds the
+    adapter, whose surface is a strict subset of the engine's."""
 
     rid: int
-    engine: ServingEngine
+    engine: object                     # LocalReplica | remote.RemoteReplica
     alive: bool = True
     draining: bool = False
     finished: int = 0
@@ -125,6 +199,7 @@ class ServingFrontend:
         time_mode: str = "wall",
         clock=time.perf_counter,
         seed: int = 0,
+        replica_factory=None,
         **engine_kwargs,
     ):
         if replicas < 1:
@@ -149,6 +224,15 @@ class ServingFrontend:
         self.capacity_probe_every = max(1, capacity_probe_every)
         self.time_mode = time_mode
         self.clock = clock
+        # A replica_factory makes the replica tier pluggable: called as
+        # (rid, clock) -> replica adapter. None = in-process engines.
+        # A factory that also exposes poll_deaths/sigkill (i.e. a
+        # remote.WorkerSupervisor) is additionally used as the process
+        # supervisor: deaths it reports drive kill_replica failover.
+        self._replica_factory = replica_factory
+        self._supervisor = (replica_factory
+                            if hasattr(replica_factory, "poll_deaths")
+                            else None)
         self._engine_kwargs = engine_kwargs
         self._rs = np.random.RandomState(seed)
         self._replicas: List[_Replica] = []
@@ -163,24 +247,34 @@ class ServingFrontend:
             "rejected_queue_full": 0, "rejected_wait_watermark": 0,
             "finished": 0,
             "failover_events": 0, "failed_over_requests": 0,
+            "worker_deaths": 0,
             "grows": 0, "shrinks": 0, "retired_replicas": 0,
             "imbalance_sum": 0.0, "imbalance_samples": 0,
             "imbalance_max": 0.0,
         }
         for _ in range(replicas):
             self._spawn_replica()
-        self.block_size = self._replicas[0].engine.cache_state.block_size
+        self.block_size = self._replicas[0].engine.block_size
 
     # -- replica set -------------------------------------------------------
 
     def _spawn_replica(self) -> _Replica:
-        eng = ServingEngine(
-            self.params, self.config, clock=self._now, **self._engine_kwargs)
-        # Replicas live in the front-end's clock domain: zero epoch, so
-        # engine timestamps ARE front-end times and wait ages computed
-        # against request arrival_time are comparable across replicas.
-        eng._t0 = 0.0
-        h = _Replica(rid=self._next_rid, engine=eng)
+        # Replicas live in the front-end's clock domain: the factory
+        # receives ``self._now`` and every replica's timestamps are
+        # front-end times (zero epoch) — in-process via clock injection,
+        # cross-process by shipping ``now`` on every step RPC. Wait ages
+        # computed against request arrival_time are therefore comparable
+        # across the whole fleet, and ``steps`` mode stays deterministic
+        # even when the replica is another OS process.
+        rid = self._next_rid
+        if self._replica_factory is not None:
+            rep = self._replica_factory(rid, self._now)
+        else:
+            eng = ServingEngine(self.params, self.config, clock=self._now,
+                                **self._engine_kwargs)
+            eng._t0 = 0.0
+            rep = LocalReplica(eng)
+        h = _Replica(rid=rid, engine=rep)
         self._next_rid += 1
         self._replicas.append(h)
         return h
@@ -190,7 +284,7 @@ class ServingFrontend:
                 if h.alive and not (routable and h.draining)]
 
     def has_work(self) -> bool:
-        return any(h.engine.scheduler.has_work() for h in self._live())
+        return any(h.engine.has_work() for h in self._live())
 
     def _now(self) -> float:
         if self.time_mode == "steps":
@@ -294,7 +388,7 @@ class ServingFrontend:
         return res
 
     def _enqueue(self, h: _Replica, req: Request, routed: str) -> None:
-        h.engine.scheduler.add(req)
+        h.engine.submit(req)
         h.routed[routed] = h.routed.get(routed, 0) + 1
         key = f"routed_{routed}"
         self.stats[key] = self.stats.get(key, 0) + 1
@@ -323,7 +417,7 @@ class ServingFrontend:
         h = victims[0]
         orphans = h.engine.export_requests()
         h.alive = False
-        h.engine.device_cache = None   # release the KV pools
+        h.engine.release()
         self.stats["failover_events"] += 1
         self.stats["failed_over_requests"] += len(orphans)
         for req in orphans:
@@ -376,33 +470,60 @@ class ServingFrontend:
 
     def _reap_draining(self) -> None:
         for h in self._replicas:
-            if h.alive and h.draining and not h.engine.scheduler.has_work():
+            if h.alive and h.draining and not h.engine.has_work():
                 h.alive = False
-                h.engine.device_cache = None
+                h.engine.release()
                 self.stats["retired_replicas"] += 1
 
     # -- the per-iteration surface ----------------------------------------
 
     def step(self) -> List[Request]:
-        """One front-end iteration: fire armed ``replica_kill`` faults,
-        probe the capacity file, reap drained replicas, then advance
-        every live replica with work by one engine step. Returns the
-        requests finished this iteration (all replicas)."""
+        """One front-end iteration: fire armed ``replica_kill`` /
+        ``worker_kill`` faults, settle worker-process deaths into
+        failover, probe the capacity file, reap drained replicas, then
+        advance every live replica with work by one engine step.
+        Returns the requests finished this iteration (all replicas)."""
         self._iters += 1
         if faults.fire("replica_kill", self._iters):
             self.kill_replica()
+        if faults.fire("worker_kill", self._iters):
+            # A REAL kill: SIGKILL the worker process; the death is
+            # settled and failed over through poll_deaths just below —
+            # the exact path an unplanned worker death takes.
+            if self._supervisor is None:
+                raise RuntimeError(
+                    "worker_kill fault armed but replicas are in-process")
+            self._supervisor.sigkill()
+        self._settle_worker_deaths()
         if self.capacity_file and self._iters % self.capacity_probe_every == 0:
             self._probe_capacity()
         self._reap_draining()
         finished: List[Request] = []
         for h in self._replicas:
-            if h.alive and h.engine.scheduler.has_work():
-                out = h.engine.step()
+            if h.alive and h.engine.has_work():
+                try:
+                    out = h.engine.step()
+                except ReplicaDied:
+                    # Died mid-RPC: any tokens the worker generated but
+                    # never reported are simply re-generated on the
+                    # survivor — sampling is keyed (seed, token_index),
+                    # so the resumed stream is unchanged.
+                    self.stats["worker_deaths"] += 1
+                    self.kill_replica(h.rid)
+                    continue
                 h.finished += len(out)
                 finished.extend(out)
         self.stats["finished"] += len(finished)
         self._sample_load()
         return finished
+
+    def _settle_worker_deaths(self) -> None:
+        if self._supervisor is None:
+            return
+        for rid in self._supervisor.poll_deaths():
+            if any(h.rid == rid and h.alive for h in self._replicas):
+                self.stats["worker_deaths"] += 1
+                self.kill_replica(rid)
 
     def _sample_load(self) -> None:
         live = self._live()
@@ -490,10 +611,9 @@ class ServingFrontend:
         if self._wait_samples:
             s["wait_age_p50"] = float(np.percentile(self._wait_samples, 50))
             s["wait_age_p99"] = float(np.percentile(self._wait_samples, 99))
-        hit = sum(h.engine.scheduler.prefix_hit_tokens for h in self._replicas)
-        prompt = sum(h.engine.scheduler.prompt_tokens for h in self._replicas)
-        gen = sum(int(h.engine.stats["generated_tokens"])
-                  for h in self._replicas)
+        hit = sum(h.engine.prefix_hit_tokens for h in self._replicas)
+        prompt = sum(h.engine.prompt_tokens for h in self._replicas)
+        gen = sum(h.engine.generated_tokens for h in self._replicas)
         s["prompt_tokens"] = prompt
         s["prefix_hit_tokens"] = hit
         s["prefix_hit_rate"] = hit / max(1, prompt)
@@ -509,12 +629,17 @@ class ServingFrontend:
                 "draining": h.draining,
                 "finished": h.finished,
                 "routed": dict(h.routed),
-                "generated_tokens": int(h.engine.stats["generated_tokens"]),
+                "generated_tokens": h.engine.generated_tokens,
                 "prefix_hit_rate": (
-                    h.engine.scheduler.prefix_hit_tokens
-                    / max(1, h.engine.scheduler.prompt_tokens)),
-                "preemptions": h.engine.scheduler.n_preemptions,
+                    h.engine.prefix_hit_tokens
+                    / max(1, h.engine.prompt_tokens)),
+                "preemptions": h.engine.n_preemptions,
             }
             for h in self._replicas
         ]
+        s["transport"] = ("rpc" if self._supervisor is not None
+                          or any(not isinstance(h.engine, LocalReplica)
+                                 for h in self._replicas)
+                          else "inproc")
+        s["worker_deaths"] = int(self.stats["worker_deaths"])
         return s

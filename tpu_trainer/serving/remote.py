@@ -1,0 +1,683 @@
+"""Cross-process serving replicas: wire protocol, remote adapter, and
+worker-process supervision.
+
+This is the front-end half of the worker-process runtime
+(``serving/worker.py`` is the other half). It turns the multi-replica
+front-end's "replica" from an in-process object into an OS process
+reached over a socket, WITHOUT touching the routing / admission /
+failover logic: ``RemoteReplica`` implements the exact narrow surface
+``ServingFrontend`` consumes (submit, step, load counters, export,
+release) and ``ServingFrontend(replica_factory=WorkerSupervisor(...))``
+is the only wiring change.
+
+**Wire protocol** — length-prefixed JSON frames over a unix socket
+(TCP opt-in)::
+
+    +----------------+---------------------------+
+    | 4 bytes        | <len> bytes               |
+    | big-endian len | UTF-8 JSON payload        |
+    +----------------+---------------------------+
+
+Requests are ``{"id", "method", ...params}``; responses are
+``{"id", "ok": true, "result"}`` or ``{"id", "ok": false, "error":
+{"type", "msg"}}``. A torn or oversized frame is a ``FrameError``: the
+worker closes that connection (and keeps accepting); the client marks
+the replica dead (``ReplicaDied``) — neither side wedges.
+
+**Why the front-end's cached load snapshot is EXACT, not stale**: the
+worker is a pure RPC reactor — its engine only mutates inside a
+submit/step/export/reset handler, never on its own clock. Every
+response therefore carries a ``load`` snapshot (queue depth,
+outstanding tokens, oldest waiting *arrival time* — time-independent,
+so wait AGE is computed client-side against the front-end clock) that
+is correct until the front-end's own next RPC. Routing and admission
+read the snapshot with zero extra round-trips.
+
+**Failover state lives on the front-end side**: ``RemoteReplica`` keeps
+the caller's ``Request`` objects as mirrors and applies the worker's
+per-step token deltas to them in place, so the objects the caller
+submitted are the objects that come back finished — and when a worker
+is SIGKILL'd mid-flight, the mirrors ARE the export: runtime state is
+reset exactly like ``Scheduler.export_requests`` (status waiting, slot
+None, cursors zeroed, sorted by ``(arrival_time, rid)``) and the
+``(seed, token_index)`` sampling state crosses the wire verbatim, so
+the resumed stream on a survivor is bit-identical to an undisturbed
+run. Tokens a worker generated but never managed to report are simply
+re-generated — same key, same tokens.
+
+``WorkerSupervisor`` reuses the elastic trainer's death machinery:
+``utils/flight_recorder`` heartbeats (one file per worker, beaten every
+RPC-loop wakeup) detect a wedged-but-alive worker by flatline, and
+``proc.poll()`` detects real deaths by exit code — the same two signals
+``training/elastic.py`` uses for hosts. Deaths are reported once and
+drive ``ServingFrontend.kill_replica``'s existing failover path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, Dict, List, Optional
+
+from tpu_trainer.serving.scheduler import Request, SamplingParams
+from tpu_trainer.utils.flight_recorder import read_heartbeat
+
+_HEADER = struct.Struct(">I")
+MAX_FRAME_BYTES = 1 << 26   # 64 MiB: a garbage length prefix must not OOM us
+
+
+class FrameError(Exception):
+    """Torn, oversized, or non-JSON frame — the connection is poisoned
+    and must be closed (the stream has no way to resynchronise)."""
+
+
+class ReplicaDied(RuntimeError):
+    """The worker behind a ``RemoteReplica`` is unreachable (killed,
+    exited, or sent a poisoned frame)."""
+
+
+# -- framing ---------------------------------------------------------------
+
+
+def encode_frame(obj) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise FrameError(f"frame of {len(body)} bytes exceeds max")
+    return _HEADER.pack(len(body)) + body
+
+
+def _recv_exact(sock: socket.socket, n: int, *, start: bytes = b"") -> bytes:
+    buf = start
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise FrameError(
+                f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket):
+    """Read one frame. Returns the decoded object, or None on a CLEAN
+    EOF (peer closed between frames). Raises ``FrameError`` on a torn
+    header/body, a length outside (0, MAX], or a non-JSON payload."""
+    first = sock.recv(_HEADER.size)
+    if not first:
+        return None                     # clean close between frames
+    hdr = _recv_exact(sock, _HEADER.size, start=first)
+    (length,) = _HEADER.unpack(hdr)
+    if length == 0 or length > MAX_FRAME_BYTES:
+        raise FrameError(f"bad frame length {length}")
+    body = _recv_exact(sock, length)
+    try:
+        return json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise FrameError(f"undecodable frame body: {e}") from e
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    sock.sendall(encode_frame(obj))
+
+
+def rpc(sock: socket.socket, req_id: int, method: str, params: dict):
+    """One blocking request/response exchange. Raises ``ReplicaDied``
+    when the peer is gone or the stream is poisoned, and re-raises
+    worker-side ``ValueError`` as ``ValueError`` (so e.g. a
+    too-long-prompt reject behaves exactly like the in-process
+    ``Scheduler.add``)."""
+    msg = dict(params)
+    msg["id"] = req_id
+    msg["method"] = method
+    try:
+        send_frame(sock, msg)
+        resp = recv_frame(sock)
+    except (OSError, FrameError) as e:
+        raise ReplicaDied(f"rpc {method!r} failed: {e}") from e
+    if resp is None:
+        raise ReplicaDied(f"connection closed during rpc {method!r}")
+    if resp.get("id") != req_id:
+        raise ReplicaDied(
+            f"rpc {method!r}: response id {resp.get('id')} != {req_id}")
+    if not resp.get("ok"):
+        err = resp.get("error") or {}
+        if err.get("type") == "ValueError":
+            raise ValueError(err.get("msg", "worker ValueError"))
+        raise ReplicaDied(f"rpc {method!r}: worker error {err}")
+    return resp.get("result") or {}
+
+
+# -- Request wire codec ----------------------------------------------------
+
+# Runtime fields synced by ``request_apply_wire`` (everything that can
+# change after construction; identity fields rid/prompt/... stay put).
+_RUNTIME_FIELDS = (
+    "status", "slot", "preemptions", "first_token_at", "finished_at",
+    "prefill_cursor", "prefill_target", "prefill_chunk",
+    "prefix_hit_tokens", "spec_drafted", "spec_accepted", "spec_steps",
+)
+
+
+def request_to_wire(req: Request) -> dict:
+    """Lossless JSON form of a ``Request`` — sampling state (incl.
+    ``top_p``), generated tokens, timestamps, cursors, and the
+    prefix-index registration watermark all cross the wire, so a
+    failover re-submit on the far side resumes exactly where the
+    original stood (the preemption-resume contract, now cross-process)."""
+    d = {
+        "rid": int(req.rid),
+        "prompt": [int(t) for t in req.prompt],
+        "max_new_tokens": int(req.max_new_tokens),
+        "sampling": dataclasses.asdict(req.sampling),
+        "arrival_time": float(req.arrival_time),
+        "eos_id": None if req.eos_id is None else int(req.eos_id),
+        "generated": [int(t) for t in req.generated],
+        "token_times": [float(t) for t in req.token_times],
+        "blocks_registered": int(req._blocks_registered),
+    }
+    for f in _RUNTIME_FIELDS:
+        d[f] = getattr(req, f)
+    return d
+
+
+def request_from_wire(d: dict) -> Request:
+    req = Request(
+        rid=int(d["rid"]),
+        prompt=list(d["prompt"]),
+        max_new_tokens=int(d["max_new_tokens"]),
+        sampling=SamplingParams(**d["sampling"]),
+        arrival_time=float(d["arrival_time"]),
+        eos_id=d.get("eos_id"),
+    )
+    req.generated = list(d.get("generated", ()))
+    req.token_times = list(d.get("token_times", ()))
+    req._blocks_registered = int(d.get("blocks_registered", 0))
+    request_apply_wire(req, d)
+    return req
+
+
+def request_apply_wire(req: Request, d: dict) -> None:
+    """Sync a local mirror's runtime state from a wire dict (used when a
+    live worker exports: the worker's view is authoritative)."""
+    req.generated = list(d.get("generated", req.generated))
+    req.token_times = list(d.get("token_times", req.token_times))
+    for f in _RUNTIME_FIELDS:
+        if f in d:
+            setattr(req, f, d[f])
+
+
+# -- params transport ------------------------------------------------------
+
+
+def save_params_npz(path: str, params) -> None:
+    """Flatten a (possibly nested-Mapping) param tree to ``a/b/c`` keys
+    and save as one npz (atomic via tmp + replace). jax-free on purpose:
+    the supervisor side must stay importable without an accelerator."""
+    import numpy as np
+
+    flat: Dict[str, "np.ndarray"] = {}
+
+    def walk(node, prefix):
+        if hasattr(node, "items"):
+            for k, v in node.items():
+                walk(v, f"{prefix}/{k}" if prefix else str(k))
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk(params, "")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)
+
+
+def load_params_npz(path: str) -> dict:
+    import numpy as np
+
+    out: dict = {}
+    with np.load(path) as z:
+        for key in z.files:
+            parts = key.split("/")
+            cur = out
+            for p in parts[:-1]:
+                cur = cur.setdefault(p, {})
+            cur[parts[-1]] = z[key]
+    return out
+
+
+# -- the remote replica adapter --------------------------------------------
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    """One spawned worker process plus its control connection."""
+
+    worker_id: int
+    proc: object                        # subprocess.Popen (duck-typed in tests)
+    sock: Optional[socket.socket]
+    log_path: str = ""
+    block_size: int = 0
+    pid: int = 0
+    rid: Optional[int] = None           # front-end replica id, once assigned
+    retired: bool = False               # deliberately shut down, not a death
+    next_id: int = 0
+
+    def rpc(self, method: str, params: Optional[dict] = None):
+        if self.sock is None:
+            raise ReplicaDied(f"worker {self.worker_id}: no connection")
+        self.next_id += 1
+        return rpc(self.sock, self.next_id, method, params or {})
+
+    def close(self, *, grace_s: float = 5.0) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        try:
+            self.proc.wait(timeout=grace_s)
+        except Exception:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=grace_s)
+            except Exception:
+                pass
+
+
+class RemoteReplica:
+    """Drop-in for an in-process replica (``frontend.LocalReplica``):
+    same surface, state mutated only by our own RPCs — see the module
+    docstring for why the cached ``load`` snapshot is exact."""
+
+    def __init__(self, handle: WorkerHandle, clock: Callable[[], float], *,
+                 supervisor: Optional["WorkerSupervisor"] = None):
+        self._handle = handle
+        self.clock = clock
+        self._supervisor = supervisor
+        self.dead = False
+        self.block_size = handle.block_size
+        self._reqs: Dict[int, Request] = {}     # unfinished mirrors
+        self._load: Dict[str, object] = {
+            "queue_depth": 0, "outstanding_tokens": 0, "has_work": False,
+            "oldest_arrival": None, "generated_tokens": 0,
+            "prefix_hit_tokens": 0, "prompt_tokens": 0, "n_preemptions": 0,
+        }
+
+    @property
+    def worker_id(self) -> int:
+        return self._handle.worker_id
+
+    @property
+    def worker_pid(self) -> int:
+        return self._handle.pid
+
+    def _rpc(self, method: str, params: Optional[dict] = None):
+        if self.dead:
+            raise ReplicaDied(
+                f"worker {self._handle.worker_id} is already dead")
+        try:
+            result = self._handle.rpc(method, params)
+        except ReplicaDied:
+            self.dead = True
+            raise
+        load = result.get("load")
+        if load is not None:
+            self._load = load
+        return result
+
+    # -- the replica surface the front-end consumes ------------------------
+
+    def submit(self, req: Request) -> None:
+        self._rpc("submit", {"req": request_to_wire(req),
+                             "now": self.clock()})
+        self._reqs[req.rid] = req
+
+    def step(self) -> List[Request]:
+        """One frontend-driven engine step on the worker. Ships the
+        front-end clock (``now``) — the worker NEVER free-runs a wall
+        clock, so one clock domain spans the fleet and ``steps`` mode is
+        deterministic cross-process. Token deltas are applied to the
+        caller's own ``Request`` objects."""
+        result = self._rpc("step", {"now": self.clock()})
+        finished: List[Request] = []
+        for d in result.get("deltas", ()):
+            req = self._reqs.get(d["rid"])
+            if req is None:
+                continue
+            req.generated.extend(d["gen"])
+            req.token_times.extend(d["times"])
+            req.first_token_at = d["first"]
+            req.preemptions = d["preempt"]
+            req.prefix_hit_tokens = d["hit"]
+            req.spec_drafted, req.spec_accepted, req.spec_steps = d["spec"]
+            req.status = d["status"]
+            if d["done"]:
+                req.finished_at = d["finished_at"]
+                finished.append(self._reqs.pop(d["rid"]))
+        return finished
+
+    def has_work(self) -> bool:
+        return bool(self._load["has_work"])
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._load["queue_depth"])
+
+    @property
+    def outstanding_tokens(self) -> int:
+        return int(self._load["outstanding_tokens"])
+
+    def oldest_wait_age(self, now: float) -> float:
+        arr = self._load.get("oldest_arrival")
+        if arr is None:
+            return 0.0
+        return max(0.0, now - float(arr))
+
+    def export_requests(self, *, waiting_only: bool = False) -> List[Request]:
+        """Drain requeueable requests. Live worker: the worker's export
+        is authoritative (preemption counts etc. sync onto the
+        mirrors). Dead worker: the mirrors are the export, reset to the
+        exact ``Scheduler.export_requests`` contract — this is the
+        SIGKILL failover path."""
+        if not self.dead:
+            try:
+                result = self._rpc("export", {"waiting_only": waiting_only})
+                out: List[Request] = []
+                for d in result.get("requests", ()):
+                    req = self._reqs.pop(d["rid"], None)
+                    if req is None:        # shouldn't happen; keep honest
+                        req = request_from_wire(d)
+                    else:
+                        request_apply_wire(req, d)
+                    out.append(req)
+                return out
+            except ReplicaDied:
+                pass
+        out = []
+        for req in self._reqs.values():
+            req.status = "waiting"
+            req.slot = None
+            req.prefill_cursor = 0
+            req.prefill_target = 0
+            req.prefill_chunk = 0
+            out.append(req)
+        self._reqs.clear()
+        return sorted(out, key=lambda r: (r.arrival_time, r.rid))
+
+    def release(self) -> None:
+        """Tear the worker down (graceful shutdown RPC when reachable,
+        then reap the process). A deliberate release is marked retired
+        so the supervisor does not report it as a death."""
+        self._handle.retired = True
+        if not self.dead:
+            try:
+                self._rpc("shutdown")
+            except (ReplicaDied, ValueError):
+                pass
+            self.dead = True
+        self._handle.close()
+
+    # -- counters mirrored for fleet telemetry -----------------------------
+
+    @property
+    def generated_tokens(self) -> int:
+        return int(self._load["generated_tokens"])
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return int(self._load["prefix_hit_tokens"])
+
+    @property
+    def prompt_tokens(self) -> int:
+        return int(self._load["prompt_tokens"])
+
+    @property
+    def n_preemptions(self) -> int:
+        return int(self._load["n_preemptions"])
+
+
+# -- supervision -----------------------------------------------------------
+
+
+class WorkerSupervisor:
+    """Launches and watches worker processes; IS the front-end's
+    ``replica_factory`` (callable ``(rid, clock) -> RemoteReplica``).
+
+    Death detection mirrors ``training/elastic.py``: a worker is dead
+    when its process exited (``proc.poll()`` — a SIGKILL shows up here
+    by exit code) or when its heartbeat file has flatlined for longer
+    than ``heartbeat_timeout_s`` (a wedged-but-alive process; the
+    supervisor SIGKILLs it on detection so the state is unambiguous).
+    ``poll_deaths`` reports each death exactly once; the front-end turns
+    each report into its existing ``kill_replica`` failover.
+
+    ``reset()`` implements warm A/B benching: every live worker rebuilds
+    a fresh engine in place (the jitted step is memoised per config
+    inside the process, so no recompile) and returns to the spawn pool —
+    the next front-end built over this supervisor adopts warm processes
+    with clean serving state.
+    """
+
+    def __init__(self, params, config, *, engine_kwargs=None,
+                 run_dir: Optional[str] = None,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 connect_timeout_s: float = 240.0,
+                 tcp: bool = False):
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.tcp = tcp
+        if run_dir is None or len(run_dir) > 70:
+            # unix socket paths are capped near 108 bytes — keep ours short
+            run_dir = tempfile.mkdtemp(prefix="tt-workers-")
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self.heartbeat_dir = os.path.join(run_dir, "hb")
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        self._params_path = os.path.join(run_dir, "params.npz")
+        self._spec_path = os.path.join(run_dir, "spec.json")
+        if params is not None:
+            save_params_npz(self._params_path, params)
+        # One PRNG scheme spans the fleet: the partitionable-threefry
+        # flag changes sampled bit streams, so the worker must run with
+        # the front-end process's setting or sampled streams lose
+        # cross-process bit-identity. Read via sys.modules — this module
+        # stays importable without jax.
+        jax_cfg = {}
+        jaxm = sys.modules.get("jax")
+        if jaxm is not None:
+            jax_cfg["threefry_partitionable"] = bool(
+                jaxm.config.jax_threefry_partitionable)
+        spec = {
+            "config": dataclasses.asdict(config) if config is not None else {},
+            "engine": dict(engine_kwargs or {}),
+            "params_npz": self._params_path,
+            "jax": jax_cfg,
+        }
+        for k, v in spec["engine"].items():
+            if not isinstance(v, (int, float, str, bool, type(None))):
+                raise ValueError(
+                    f"engine kwarg {k!r} is not wire-able: {type(v)}")
+        with open(self._spec_path, "w") as f:
+            json.dump(spec, f)
+        self._handles: Dict[int, WorkerHandle] = {}   # by front-end rid
+        self._pool: List[WorkerHandle] = []           # warm, unassigned
+        self._spawned = 0
+        self._reported_dead: set = set()
+
+    # -- factory surface ---------------------------------------------------
+
+    def __call__(self, rid: int, clock: Callable[[], float]) -> RemoteReplica:
+        handle = self._pool.pop(0) if self._pool else self._spawn()
+        handle.rid = rid
+        self._handles[rid] = handle
+        return RemoteReplica(handle, clock, supervisor=self)
+
+    # kept as an explicit alias so call sites can say what they mean
+    def replica_factory(self, rid: int, clock) -> RemoteReplica:
+        return self(rid, clock)
+
+    def prewarm(self, n: int) -> None:
+        """Spawn ``n`` workers CONCURRENTLY into the pool: all processes
+        launch first (their jax imports and engine builds overlap), then
+        each is connected and handshaken. The front-end's sequential
+        ``replica_factory`` calls then adopt warm workers, so fleet
+        startup costs ~one worker build instead of N."""
+        launched = [self._launch() for _ in range(n)]
+        for wid, proc, log_path in launched:
+            self._pool.append(self._handshake(wid, proc, log_path))
+
+    def _spawn(self) -> WorkerHandle:
+        return self._handshake(*self._launch())
+
+    def _launch(self):
+        wid = self._spawned
+        self._spawned += 1
+        log_path = os.path.join(self.run_dir, f"worker{wid}.log")
+        cmd = [sys.executable, "-m", "tpu_trainer.serving.worker",
+               "--spec", self._spec_path,
+               "--heartbeat-dir", self.heartbeat_dir,
+               "--worker-id", str(wid)]
+        if self.tcp:
+            cmd += ["--tcp", "127.0.0.1:0", "--addr-file",
+                    os.path.join(self.run_dir, f"worker{wid}.addr")]
+        else:
+            cmd += ["--socket", os.path.join(self.run_dir, f"w{wid}.sock")]
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(cmd, stdout=log, stderr=log)
+        return wid, proc, log_path
+
+    def _handshake(self, wid: int, proc, log_path: str) -> WorkerHandle:
+        try:
+            sock = self._connect(wid, proc)
+            handle = WorkerHandle(worker_id=wid, proc=proc, sock=sock,
+                                  log_path=log_path)
+            hello = handle.rpc("hello")
+        except Exception:
+            proc.kill()
+            raise
+        handle.block_size = int(hello["block_size"])
+        handle.pid = int(hello["pid"])
+        return handle
+
+    def _connect(self, wid: int, proc) -> socket.socket:
+        deadline = time.monotonic() + self.connect_timeout_s
+        addr_file = os.path.join(self.run_dir, f"worker{wid}.addr")
+        sock_path = os.path.join(self.run_dir, f"w{wid}.sock")
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker {wid} exited rc={proc.returncode} before "
+                    f"accepting (see {self.run_dir}/worker{wid}.log)")
+            try:
+                if self.tcp:
+                    with open(addr_file) as f:
+                        host, port = f.read().strip().rsplit(":", 1)
+                    s = socket.create_connection((host, int(port)), timeout=5)
+                else:
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(sock_path)
+                s.settimeout(600.0)   # first step pays the worker's compile
+                return s
+            except (OSError, FileNotFoundError, ValueError):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"worker {wid}: no socket within "
+                        f"{self.connect_timeout_s}s")
+                time.sleep(0.05)
+
+    # -- death detection ---------------------------------------------------
+
+    def sigkill(self, rid: Optional[int] = None) -> int:
+        """Hard-kill one worker process (the ``worker_kill`` fault).
+        Target: ``TPU_TRAINER_FAULT_REPLICA`` env override, else the
+        highest assigned live rid — the same convention as
+        ``replica_kill``. Waits for the exit to settle so the very next
+        ``poll_deaths`` reports it deterministically."""
+        cands = {r: h for r, h in self._handles.items()
+                 if not h.retired and h.proc.poll() is None}
+        if not cands:
+            raise RuntimeError("no live workers to kill")
+        if rid is None:
+            raw = os.environ.get("TPU_TRAINER_FAULT_REPLICA")
+            rid = int(raw) if raw is not None else max(cands)
+        if rid not in cands:
+            raise ValueError(f"worker for replica {rid} is not alive")
+        h = cands[rid]
+        os.kill(h.proc.pid, signal.SIGKILL)
+        try:
+            h.proc.wait(timeout=10)
+        except Exception:
+            pass
+        return rid
+
+    def poll_deaths(self) -> List[int]:
+        """Replica ids whose worker died since the last poll (exit code
+        OR heartbeat flatline), each reported exactly once."""
+        dead: List[int] = []
+        now = time.time()
+        for rid, h in self._handles.items():
+            if h.retired or rid in self._reported_dead:
+                continue
+            if h.proc.poll() is not None:
+                dead.append(rid)
+                continue
+            if self.heartbeat_timeout_s is not None:
+                beat = read_heartbeat(self.heartbeat_dir, h.worker_id)
+                if beat is not None and (
+                        now - float(beat.get("unix", now))
+                        > self.heartbeat_timeout_s):
+                    h.proc.kill()       # settle the wedged process
+                    dead.append(rid)
+        self._reported_dead.update(dead)
+        return dead
+
+    def live_worker_count(self) -> int:
+        return sum(1 for h in list(self._handles.values()) + self._pool
+                   if not h.retired and h.proc.poll() is None)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset(self) -> None:
+        """Return every live assigned worker to the pool with a fresh
+        engine (process + compile cache kept). Dead/retired handles are
+        dropped."""
+        for rid, h in list(self._handles.items()):
+            if h.retired or h.proc.poll() is not None:
+                continue
+            try:
+                h.rpc("reset")
+            except (ReplicaDied, ValueError):
+                h.close(grace_s=1.0)
+                continue
+            h.rid = None
+            self._pool.append(h)
+        self._handles.clear()
+        self._reported_dead.clear()
+
+    def close(self) -> None:
+        for h in list(self._handles.values()) + self._pool:
+            if not h.retired and h.sock is not None:
+                try:
+                    h.rpc("shutdown")
+                except (ReplicaDied, ValueError):
+                    pass
+            h.retired = True
+            h.close(grace_s=2.0)
+        self._handles.clear()
+        self._pool.clear()
+
+    def __enter__(self) -> "WorkerSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

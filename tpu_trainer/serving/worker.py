@@ -1,0 +1,308 @@
+"""One serving replica as its own OS process: ``python -m
+tpu_trainer.serving.worker`` runs a single ``ServingEngine`` behind the
+length-prefixed JSON RPC loop defined in ``serving/remote.py``.
+
+The worker is a pure **RPC reactor** — the engine advances ONLY inside
+a handler, never on its own schedule. That one design choice buys the
+two properties the cross-process front-end needs:
+
+- **Determinism**: the front-end drives every engine step and ships its
+  own clock value (``now``) with each step RPC; the worker's engine is
+  built with a captured clock (``clock=lambda: last now received``,
+  zero epoch), so in ``steps`` mode every timestamp in the fleet is a
+  front-end iteration number — one clock domain, bit-reproducible.
+- **Exact load snapshots**: worker state between RPCs is frozen, so the
+  ``load`` dict attached to every response (queue depth, outstanding
+  tokens, oldest waiting ARRIVAL — age is computed front-end-side) is
+  correct until the front-end's next call, with zero polling.
+
+Token streams cross the wire as **deltas**: the worker tracks how many
+generated tokens each request has already reported and sends only the
+new suffix (plus timestamps and terminal state) per step — the
+front-end applies them to its own mirror ``Request`` objects.
+
+Liveness: a ``utils/flight_recorder`` heartbeat is beaten on every loop
+wakeup (idle ``select`` timeouts included, throttled), so a healthy but
+idle worker stays visibly alive while a wedged handler flatlines within
+a second — the same signal the elastic trainer uses for hung hosts.
+
+A torn or non-JSON frame poisons only the CONNECTION, not the process:
+the worker closes that socket and goes back to ``accept``, so a
+reconnecting front-end finds clean state and live requests survive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import select
+import socket
+import sys
+from typing import Dict, List, Optional
+
+from tpu_trainer.serving.remote import (
+    FrameError,
+    load_params_npz,
+    recv_frame,
+    request_from_wire,
+    request_to_wire,
+    send_frame,
+)
+from tpu_trainer.serving.scheduler import Request
+from tpu_trainer.utils.flight_recorder import HeartbeatWriter
+
+
+def _jsonable(x):
+    """Engine summaries carry numpy scalars; JSON does not."""
+    if isinstance(x, dict):
+        return {k: _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_jsonable(v) for v in x]
+    if hasattr(x, "item") and not isinstance(x, (str, bytes)):
+        return x.item()
+    return x
+
+
+class WorkerServer:
+    """The RPC reactor around one ``ServingEngine``."""
+
+    def __init__(self, spec: dict, *, worker_id: int = 0,
+                 heartbeat_dir: Optional[str] = None):
+        self.spec = spec
+        self.worker_id = worker_id
+        self._now_value = 0.0
+        self._steps = 0
+        self._shutdown = False
+        self._hb = (HeartbeatWriter(heartbeat_dir, host=worker_id,
+                                    min_interval_s=0.2)
+                    if heartbeat_dir else None)
+        self._reqs: Dict[int, Request] = {}
+        self._sent: Dict[int, int] = {}    # generated tokens already reported
+        self.engine = self._build_engine()
+
+    def _build_engine(self):
+        # Imported here, not at module top: the heavy jax stack loads in
+        # the worker process only, and only once argument parsing and
+        # socket binding have already succeeded.
+        import jax
+
+        # Adopt the front-end process's PRNG scheme (recorded in the
+        # spec by WorkerSupervisor): partitionable threefry changes
+        # sampled bit streams, and cross-process bit-identity requires
+        # every engine in the fleet to draw from the same one.
+        jax_cfg = self.spec.get("jax", {})
+        if "threefry_partitionable" in jax_cfg:
+            jax.config.update("jax_threefry_partitionable",
+                              bool(jax_cfg["threefry_partitionable"]))
+
+        from tpu_trainer.models.config import GPTConfig
+        from tpu_trainer.serving.engine import ServingEngine
+
+        params = load_params_npz(self.spec["params_npz"])
+        config = GPTConfig(**self.spec["config"])
+        eng = ServingEngine(params, config, clock=lambda: self._now_value,
+                            **self.spec.get("engine", {}))
+        eng._t0 = 0.0   # front-end clock domain: timestamps ARE its times
+        return eng
+
+    def _beat(self) -> None:
+        if self._hb is not None:
+            self._hb.beat(self._steps)
+
+    # -- load snapshot (see module docstring: exact between our RPCs) ------
+
+    def _load(self) -> dict:
+        eng = self.engine
+        arr = eng.scheduler.oldest_waiting_arrival
+        return {
+            "queue_depth": int(eng.queue_depth),
+            "outstanding_tokens": int(eng.outstanding_tokens),
+            "has_work": bool(eng.scheduler.has_work()),
+            "oldest_arrival": None if arr is None else float(arr),
+            "generated_tokens": int(eng.stats["generated_tokens"]),
+            "prefix_hit_tokens": int(eng.scheduler.prefix_hit_tokens),
+            "prompt_tokens": int(eng.scheduler.prompt_tokens),
+            "n_preemptions": int(eng.scheduler.n_preemptions),
+        }
+
+    # -- handlers ----------------------------------------------------------
+
+    def _delta(self, req: Request) -> dict:
+        sent = self._sent[req.rid]
+        return {
+            "rid": req.rid,
+            "gen": req.generated[sent:],
+            "times": [float(t) for t in req.token_times[sent:]],
+            "first": req.first_token_at,
+            "status": req.status,
+            "done": req.status == "finished",
+            "finished_at": req.finished_at,
+            "preempt": req.preemptions,
+            "hit": req.prefix_hit_tokens,
+            "spec": [req.spec_drafted, req.spec_accepted, req.spec_steps],
+        }
+
+    def handle(self, msg: dict) -> dict:
+        method = msg.get("method")
+        if method == "hello":
+            return {"block_size": int(self.engine.cache_state.block_size),
+                    "pid": os.getpid(), "worker_id": self.worker_id,
+                    "load": self._load()}
+        if method == "ping":
+            return {}
+        if method == "submit":
+            req = request_from_wire(msg["req"])
+            self.engine.scheduler.add(req)
+            self._reqs[req.rid] = req
+            self._sent[req.rid] = len(req.generated)
+            return {"load": self._load()}
+        if method == "step":
+            self._now_value = float(msg.get("now", self._now_value))
+            self.engine.step()
+            self._steps += 1
+            deltas: List[dict] = []
+            for rid, req in list(self._reqs.items()):
+                if len(req.generated) > self._sent[rid] or (
+                        req.status == "finished"):
+                    deltas.append(self._delta(req))
+                    self._sent[rid] = len(req.generated)
+                    if req.status == "finished":
+                        del self._reqs[rid]
+                        del self._sent[rid]
+            return {"deltas": deltas, "load": self._load()}
+        if method == "export":
+            reqs = self.engine.export_requests(
+                waiting_only=bool(msg.get("waiting_only", False)))
+            for r in reqs:
+                self._reqs.pop(r.rid, None)
+                self._sent.pop(r.rid, None)
+            return {"requests": [request_to_wire(r) for r in reqs],
+                    "load": self._load()}
+        if method == "summary":
+            return {"summary": _jsonable(self.engine.summary()),
+                    "load": self._load()}
+        if method == "reset":
+            # Fresh engine, warm process: the jitted step is memoised per
+            # frozen config inside this process, so no recompile.
+            self._reqs.clear()
+            self._sent.clear()
+            self.engine = self._build_engine()
+            self._steps = 0
+            return {"load": self._load()}
+        if method == "shutdown":
+            self._shutdown = True
+            return {}
+        raise ValueError(f"unknown method {method!r}")
+
+    # -- the socket loop ---------------------------------------------------
+
+    def serve(self, srv: socket.socket) -> None:
+        srv.setblocking(False)
+        self._beat()
+        while not self._shutdown:
+            r, _, _ = select.select([srv], [], [], 0.5)
+            self._beat()
+            if not r:
+                continue
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                continue
+            self._serve_conn(conn)
+        if self._hb is not None:
+            self._hb.stop()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn.setblocking(True)
+        try:
+            while not self._shutdown:
+                r, _, _ = select.select([conn], [], [], 0.5)
+                self._beat()
+                if not r:
+                    continue
+                try:
+                    msg = recv_frame(conn)
+                except FrameError:
+                    return              # poisoned stream: drop this client
+                if msg is None:
+                    return              # clean disconnect
+                try:
+                    result = self.handle(msg)
+                    resp = {"id": msg.get("id"), "ok": True, "result": result}
+                except ValueError as e:
+                    resp = {"id": msg.get("id"), "ok": False,
+                            "error": {"type": "ValueError", "msg": str(e)}}
+                except Exception as e:  # keep serving other requests
+                    resp = {"id": msg.get("id"), "ok": False,
+                            "error": {"type": type(e).__name__,
+                                      "msg": str(e)}}
+                try:
+                    send_frame(conn, _jsonable(resp))
+                except OSError:
+                    return
+                self._beat()
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="one ServingEngine replica behind a JSON-RPC socket")
+    p.add_argument("--spec", required=True,
+                   help="JSON file: {config, engine kwargs, params_npz}")
+    p.add_argument("--socket", default=None,
+                   help="unix socket path to listen on (the default "
+                        "transport)")
+    p.add_argument("--tcp", default=None, metavar="HOST:PORT",
+                   help="listen on TCP instead (port 0 = ephemeral)")
+    p.add_argument("--addr-file", default=None,
+                   help="with --tcp: write the bound host:port here")
+    p.add_argument("--heartbeat-dir", default=None)
+    p.add_argument("--worker-id", type=int, default=0)
+    args = p.parse_args(argv)
+    if not args.socket and not args.tcp:
+        p.error("one of --socket or --tcp is required")
+
+    with open(args.spec) as f:
+        spec = json.load(f)
+
+    # Bind BEFORE the (slow) engine build so the supervisor's connect
+    # succeeds immediately; its first RPC simply waits for accept.
+    if args.tcp:
+        host, port = args.tcp.rsplit(":", 1)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, int(port)))
+        if args.addr_file:
+            bound = srv.getsockname()
+            tmp = f"{args.addr_file}.tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{bound[0]}:{bound[1]}")
+            os.replace(tmp, args.addr_file)
+    else:
+        if os.path.exists(args.socket):
+            os.unlink(args.socket)
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(args.socket)
+    srv.listen(4)
+
+    server = WorkerServer(spec, worker_id=args.worker_id,
+                          heartbeat_dir=args.heartbeat_dir)
+    try:
+        server.serve(srv)
+    finally:
+        srv.close()
+        if args.socket and os.path.exists(args.socket):
+            try:
+                os.unlink(args.socket)
+            except OSError:
+                pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -287,7 +287,25 @@ def summarize(records: List[dict]) -> dict:
             "rejected", "reject_rate", "prefix_hit_rate",
             "load_imbalance_mean", "load_imbalance_max",
             "failover_events", "failed_over_requests", "wait_age_p99_s",
+            "transport", "workers", "worker_deaths",
             ) if f.get(k) is not None}
+        # The RPC-overhead fields live on the cross-process A/B lane's
+        # record, which may not be the newest (a worker_kill lane often
+        # follows it) — scan for the newest rpc-transport record.
+        rpc = next((r for r in reversed(fronts)
+                    if r.get("transport") == "rpc"
+                    and r.get("rpc_overhead_p99_s") is not None),
+                   None) or next((r for r in reversed(fronts)
+                                  if r.get("transport") == "rpc"), None)
+        if rpc is not None:
+            for k in ("rpc_overhead_p50_s", "rpc_overhead_p99_s",
+                      "tok_s_vs_inproc", "inproc_tokens_per_s"):
+                if rpc.get(k) is not None:
+                    report["frontend"][k] = rpc.get(k)
+            report["frontend"]["transport"] = "rpc"
+            report["frontend"]["workers"] = rpc.get("workers")
+            report["frontend"]["worker_deaths"] = max(
+                int(r.get("worker_deaths") or 0) for r in fronts)
         ab = next((r for r in reversed(fronts)
                    if r.get("random_prefix_hit_rate") is not None), None)
         if ab is None:
@@ -560,6 +578,18 @@ def render(report: dict) -> List[str]:
             f" max {_fmt(fe.get('load_imbalance_max'))}"
             f" | failovers {fe.get('failover_events') or 0}"
             f" ({fe.get('failed_over_requests') or 0} reqs)")
+        if fe.get("transport") == "rpc":
+            line = (f"frontend transport rpc ({fe.get('workers')} worker"
+                    f" processes, {fe.get('worker_deaths') or 0} deaths)")
+            if fe.get("rpc_overhead_p99_s") is not None:
+                line += (
+                    f" | RPC overhead p50"
+                    f" {_fmt((fe.get('rpc_overhead_p50_s') or 0) * 1e3, 1)}ms"
+                    f" p99"
+                    f" {_fmt((fe.get('rpc_overhead_p99_s') or 0) * 1e3, 1)}ms")
+            if fe.get("tok_s_vs_inproc") is not None:
+                line += f" | tok/s x{_fmt(fe.get('tok_s_vs_inproc'))} vs in-process"
+            lines.append(line)
         ab = fe.get("ab")
         if ab:
             lines.append(
@@ -615,7 +645,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             plan_tol: float = 0.30,
             moe_drop_tol: float = 0.0,
             spec_accept_tol: float = 0.0,
-            reject_tol: float = 0.05) -> List[dict]:
+            reject_tol: float = 0.05,
+            rpc_overhead_tol: float = 1.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -676,8 +707,9 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
     capacity-mode or non-MoE runs (drops there are a tuning choice, not a
     bug).
 
-    Two front-end gates cover multi-replica serving runs (``kind=
-    "frontend"`` records from ``serve_bench --replicas``):
+    Three front-end gates cover multi-replica serving runs (``kind=
+    "frontend"`` records from ``serve_bench --replicas`` /
+    ``--workers``):
 
     - ``frontend_reject_rate`` is ABSOLUTE against a fixed ceiling:
       the share of submitted requests shed at admission must stay under
@@ -694,6 +726,12 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
       to buy cache hits; losing to a coin flip means the key, the
       rendezvous hash, or the spill threshold is broken. SKIP when the
       record set carries no A/B pair.
+    - ``frontend_rpc_overhead`` is ABSOLUTE against a fixed budget:
+      the p99 per-request RPC overhead of cross-process serving
+      (``serve_bench --workers --ab`` stamps the rpc lane's record with
+      the submit-to-first-token delta vs the identical in-process fleet
+      on the same trace) must stay under ``rpc_overhead_tol`` seconds.
+      SKIP on in-process runs (no rpc record, or no A/B delta).
     """
     def get(report, *keys):
         cur = report
@@ -943,6 +981,30 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "new": round(aff_hit, 4),
             "absolute": True,
         })
+
+    # RPC overhead is ABSOLUTE against a fixed budget, like the elastic
+    # gates: the p99 per-request submit-to-first-token cost of the wire
+    # (measured by serve_bench --workers --ab against the identical
+    # in-process fleet on the same trace) must stay under
+    # ``rpc_overhead_tol`` seconds regardless of the baseline — framing
+    # + socket dispatch costing a second per request is broken whether
+    # or not it was broken last week. SKIP on in-process runs (no rpc
+    # record or no A/B to measure the delta against).
+    new_ovh = get(new, "frontend", "rpc_overhead_p99_s")
+    if new_ovh is None:
+        verdicts.append({"metric": "frontend_rpc_overhead",
+                         "verdict": "SKIP",
+                         "base": get(base, "frontend", "rpc_overhead_p99_s"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "frontend_rpc_overhead",
+            "verdict": "FAIL" if new_ovh > rpc_overhead_tol + eps else "PASS",
+            "base": get(base, "frontend", "rpc_overhead_p99_s"),
+            "new": round(new_ovh, 5),
+            "tolerance_s": rpc_overhead_tol,
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -1036,6 +1098,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "hit-rate gate needs no tolerance: affinity "
                              "losing to random in the same --ab run is a "
                              "categorical FAIL")
+    parser.add_argument("--rpc-overhead-tol", type=float, default=1.0,
+                        help="ABSOLUTE gate on cross-process serving: FAIL "
+                             "if the p99 per-request RPC overhead (the "
+                             "submit-to-first-token delta vs the identical "
+                             "in-process fleet, serve_bench --workers --ab) "
+                             "exceeds this many seconds (default 1.0); SKIP "
+                             "on in-process runs")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -1062,7 +1131,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             pack_tol=args.pack_tol, plan_tol=args.plan_tol,
             moe_drop_tol=args.moe_drop_tol,
             spec_accept_tol=args.spec_accept_tol,
-            reject_tol=args.reject_tol)
+            reject_tol=args.reject_tol,
+            rpc_overhead_tol=args.rpc_overhead_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

@@ -41,6 +41,14 @@ programmatically (tests) or from the ``--inject_fault`` debug flag:
   front-end iteration N (default: the highest-id live replica; override
   with ``TPU_TRAINER_FAULT_REPLICA``). Its queued and in-flight requests
   must fail over to the survivors and finish token-identically.
+- ``worker_kill@N``   — chaos lane, serving tier: like ``replica_kill``
+  but CROSS-PROCESS — at front-end iteration N the worker supervisor
+  (``serving/remote.WorkerSupervisor``) sends a real ``SIGKILL`` to one
+  worker process (default: the highest-id live worker; override with
+  ``TPU_TRAINER_FAULT_REPLICA``, same convention as ``replica_kill``).
+  The death must be detected by exit code, and the front-end's mirror
+  state must fail the worker's queued and in-flight requests over to
+  the surviving processes bit-identically.
 - ``return_host@N``   — chaos lane: at step N rank 0 writes a capacity
   grant to the supervisor's capacity file (``TPU_TRAINER_CAPACITY_FILE``),
   simulating a preempted host coming back — the grow probe
@@ -73,7 +81,7 @@ from typing import List, Optional, Tuple
 KINDS = frozenset(
     {"nan_loss", "loss_spike", "kill", "kill_in_save", "truncate_meta",
      "corrupt_shard", "sigterm", "kill_host", "hang_host",
-     "preempt_notice", "return_host", "replica_kill"}
+     "preempt_notice", "return_host", "replica_kill", "worker_kill"}
 )
 
 # Kinds that act on :func:`target_host`'s rank(s) only.
