@@ -232,6 +232,28 @@ def main(argv=None) -> int:
                    help="with --replicas: add a lane that kills one "
                         "replica at this front-end iteration "
                         "(replica_kill fault) and proves failover drains")
+    p.add_argument("--worker-hang", type=int, default=0,
+                   help="with --workers: add a lane that SIGSTOPs one "
+                        "worker process at this front-end iteration "
+                        "(worker_hang fault) — a hang, not a death: the "
+                        "per-call RPC timeout must fence the suspect and "
+                        "failover must drain")
+    p.add_argument("--net-fault", default=None, metavar="SPEC",
+                   help="with --workers: add a lane armed with this "
+                        "fault plan (e.g. net_delay@4,net_drop@8 — "
+                        "kinds net_delay/net_drop/net_garble/net_hang), "
+                        "driving transient and lethal transport faults "
+                        "through the framed RPC layer")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="front-end lanes: attach an absolute completion "
+                        "deadline of arrival + this many seconds to every "
+                        "request in the timed run (0 = off); expiries "
+                        "count as deadline_exceeded, not drain failures, "
+                        "and the record gains deadline-miss rate/slack")
+    p.add_argument("--rpc-timeout", type=float, default=0.0,
+                   help="with --workers: per-call RPC timeout in seconds "
+                        "after the first step response (0 = supervisor "
+                        "default); bounds the stall a hung worker causes")
     p.add_argument("--max-queue", type=int, default=0,
                    help="front-end per-replica waiting-queue bound "
                         "(0 = requests, i.e. no rejects from depth)")
@@ -268,6 +290,9 @@ def main(argv=None) -> int:
         # Worker lanes reuse the whole front-end lane machinery; the
         # fleet size IS the replica count, just cross-process.
         args.replicas = args.workers
+    if (args.worker_hang > 0 or args.net_fault) and args.workers <= 0:
+        p.error("--worker-hang/--net-fault need --workers (they fault "
+                "the RPC transport)")
 
     if args.smoke:
         args.requests = 16
@@ -701,7 +726,14 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     submit-to-first-token delta vs the in-process lane on the same
     trace — as ``rpc_overhead_p50_s``/``rpc_overhead_p99_s``.
     ``--worker-kill I`` adds a lane that SIGKILLs a real worker process
-    at front-end iteration I (the ``worker_kill`` fault)."""
+    at front-end iteration I (the ``worker_kill`` fault);
+    ``--worker-hang I`` adds the SIGSTOP fence drill (``worker_hang``:
+    the per-call RPC timeout must bound the stall before failover); and
+    ``--net-fault SPEC`` adds a lane armed with an arbitrary transport
+    fault plan (``net_delay``/``net_drop``/``net_garble``/``net_hang``).
+    ``--deadline D`` attaches ``arrival + D`` deadlines to the timed
+    run's requests, so records gain deadline-miss rate/slack and the
+    drain gate accepts ``deadline_exceeded`` as a terminal outcome."""
     import json
 
     import numpy as np
@@ -723,7 +755,11 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     def make_supervisor():
         from tpu_trainer.serving.remote import WorkerSupervisor
 
-        sup = WorkerSupervisor(params, cfg, engine_kwargs=engine_kwargs)
+        sup_kwargs = {}
+        if args.rpc_timeout > 0:
+            sup_kwargs["rpc_timeout_s"] = args.rpc_timeout
+        sup = WorkerSupervisor(params, cfg, engine_kwargs=engine_kwargs,
+                               **sup_kwargs)
         sup.prewarm(args.replicas)
         supervisors.append(sup)
         return sup
@@ -737,7 +773,18 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             **engine_kwargs,
         )
 
-    def run_lane(lane, routing, kill_step=0, transport="inproc"):
+    def timed_trace():
+        # Deadlines go on the TIMED run only: the warm-up run pays the
+        # compiles, and expiring requests there would skip batch shapes
+        # the timed run then compiles — polluting the miss metrics with
+        # compile stalls the warm-up exists to remove.
+        trace = make_trace()
+        if args.deadline > 0:
+            for r in trace:
+                r.deadline = r.arrival_time + args.deadline
+        return trace
+
+    def run_lane(lane, routing, fault_spec=None, transport="inproc"):
         if transport == "rpc":
             # Warm-up compiles inside the worker PROCESSES, so they must
             # survive into the timed run: reset() rebuilds each worker's
@@ -750,15 +797,19 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         else:
             build(routing).run(make_trace())   # warm-up: compiles shapes
             fe = build(routing)
-        if kill_step > 0:
-            kind = "worker_kill" if transport == "rpc" else "replica_kill"
-            with faults.plan(f"{kind}@{kill_step}"):
-                finished = fe.run(make_trace())
+        if fault_spec:
+            with faults.plan(fault_spec):
+                finished = fe.run(timed_trace())
         else:
-            finished = fe.run(make_trace())
+            finished = fe.run(timed_trace())
         s = fe.summary()
         lat = request_metrics(finished)
-        drained = int(s["finished"]) == int(s["accepted"])
+        # Conservation at drain: every ACCEPTED request reached exactly
+        # one terminal state (cancellation and deadline expiry are
+        # outcomes, not losses).
+        drained = int(s["accepted"]) == (
+            int(s["finished"]) + int(s["cancelled"])
+            + int(s["deadline_exceeded"]))
         record = {
             "kind": "frontend",
             "schema_version": SCHEMA_VERSION,
@@ -782,6 +833,10 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             "submitted": int(s["submitted"]),
             "accepted": int(s["accepted"]),
             "rejected": int(s["rejected"]),
+            "finished": int(s["finished"]),
+            "cancelled": int(s["cancelled"]),
+            "deadline_exceeded": int(s["deadline_exceeded"]),
+            "failed": int(s["failed"]),
             "reject_rate": round(float(s["reject_rate"]), 4),
             "prompt_tokens": int(s["prompt_tokens"]),
             "prefix_hit_tokens": int(s["prefix_hit_tokens"]),
@@ -801,6 +856,12 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
                  "prefix_hit_rate": round(p["prefix_hit_rate"], 4)}
                 for p in s["per_replica"]],
         }
+        for k in ("deadline_miss_rate", "deadline_miss_slack_p50",
+                  "deadline_miss_slack_p99", "stall_recovery_max_s"):
+            if k in s:
+                record[k] = round(float(s[k]), 5)
+        if "fenced" in s:
+            record["fenced"] = int(s["fenced"])
         for name, series in lat.items():
             if series:
                 record[f"{name}_p50_s"] = round(
@@ -815,25 +876,30 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     if workers_mode:
         # Transport A/B: the same trace, same routing, same fleet size —
         # in-process vs one-OS-process-per-replica over RPC.
-        lanes = [("inproc", args.routing, 0, "inproc")] if args.ab else []
-        lanes.append(("rpc", args.routing, 0, "rpc"))
+        lanes = [("inproc", args.routing, None, "inproc")] if args.ab else []
+        lanes.append(("rpc", args.routing, None, "rpc"))
         if args.worker_kill > 0:
-            lanes.append(
-                ("worker_kill", args.routing, args.worker_kill, "rpc"))
+            lanes.append(("worker_kill", args.routing,
+                          f"worker_kill@{args.worker_kill}", "rpc"))
+        if args.worker_hang > 0:
+            lanes.append(("worker_hang", args.routing,
+                          f"worker_hang@{args.worker_hang}", "rpc"))
+        if args.net_fault:
+            lanes.append(("net_fault", args.routing, args.net_fault, "rpc"))
     elif args.ab:
         b_routing = args.routing if args.routing != "random" else "affinity"
-        lanes = [("random", "random", 0, "inproc"),
-                 (b_routing, b_routing, 0, "inproc")]
+        lanes = [("random", "random", None, "inproc"),
+                 (b_routing, b_routing, None, "inproc")]
     else:
-        lanes = [(args.routing, args.routing, 0, "inproc")]
+        lanes = [(args.routing, args.routing, None, "inproc")]
     if args.replica_kill > 0 and not workers_mode:
-        lanes.append(("replica_kill", args.routing, args.replica_kill,
-                      "inproc"))
+        lanes.append(("replica_kill", args.routing,
+                      f"replica_kill@{args.replica_kill}", "inproc"))
 
     records, all_drained, lane_ttfts = [], True, {}
     try:
-        for lane, routing, kill, transport in lanes:
-            rec, drained, ttfts = run_lane(lane, routing, kill, transport)
+        for lane, routing, spec, transport in lanes:
+            rec, drained, ttfts = run_lane(lane, routing, spec, transport)
             all_drained = all_drained and drained
             records.append(rec)
             lane_ttfts[lane] = ttfts
@@ -896,7 +962,8 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     failures = []
     if not all_drained:
         failures.append(
-            "front-end did not drain (an accepted request never finished)")
+            "front-end did not drain (an accepted request never reached "
+            "a terminal state: finished/cancelled/deadline_exceeded)")
     if args.ttft_p99_gate > 0:
         p99 = records[-1].get("ttft_p99_s")
         if p99 is None or p99 > args.ttft_p99_gate:
@@ -912,9 +979,21 @@ def _print_frontend_record(r) -> None:
           f"{r['replicas']} replicas ({r['replicas_live']} live, routing "
           f"{r['routing']}), {r['accepted']}/{r['submitted']} accepted, "
           f"{r['generated_tokens']} tokens, {r['wall_s']:.2f}s", flush=True)
+    if r.get("cancelled") or r.get("deadline_exceeded"):
+        line = (f"outcome {r['finished']} finished, "
+                f"{r['cancelled']} cancelled, "
+                f"{r['deadline_exceeded']} deadline_exceeded")
+        if r.get("deadline_miss_rate") is not None:
+            line += (f" | deadline miss rate {r['deadline_miss_rate']:.3f} "
+                     f"slack p99 {r['deadline_miss_slack_p99']:.3f}s")
+        print(line, flush=True)
     if r.get("transport") == "rpc":
         line = (f"rpc     {r['workers']} worker processes, "
                 f"{r['worker_deaths']} deaths")
+        if r.get("fenced"):
+            line += f", {r['fenced']} fenced"
+        if r.get("stall_recovery_max_s") is not None:
+            line += f", max stall {r['stall_recovery_max_s']:.2f}s"
         if r.get("rpc_overhead_p99_s") is not None:
             line += (f", RPC overhead p50 "
                      f"{r['rpc_overhead_p50_s'] * 1e3:.1f} ms p99 "

@@ -131,4 +131,33 @@ python benchmarks/serve_bench.py --smoke --workload shared_prefix \
 python -m tpu_trainer.tools.analyze "$WORKER_OUT" \
   --compare "$WORKER_OUT" --reject-tol 0.0 --rpc-overhead-tol 5.0
 
+# 9. Hung worker (SIGSTOP, not SIGKILL): nothing exits, so the per-call
+#    RPC timeout is the only thing standing between the front-end and an
+#    unbounded stall. The fence drill asserts the suspect is SIGKILL'd,
+#    failover drains bit-identically, and the observed stall stays under
+#    the stall-recovery budget (rpc timeout 5s, budget 15s).
+HANG_OUT="$OUT/worker_hang.jsonl"
+rm -f "$HANG_OUT"
+echo "== chaos: worker_hang (hung-RPC fence) =="
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --workers 2 --worker-hang 6 --rpc-timeout 5 --out "$HANG_OUT"
+python -m tpu_trainer.tools.analyze "$HANG_OUT" \
+  --compare "$HANG_OUT" --reject-tol 0.0 --stall-recovery-tol 15.0
+
+# 10. Network faults + deadlines: a transient delay (call must still
+#     succeed) and a torn frame (connection death -> failover) against a
+#     fleet serving deadline-carrying requests. The drain gate accepts
+#     deadline_exceeded as a terminal outcome; analyze gates the miss
+#     rate (loose ceiling — the fault lane exists to cause some misses,
+#     not unbounded ones) and the failover stall budget.
+NET_OUT="$OUT/net_faults.jsonl"
+rm -f "$NET_OUT"
+echo "== chaos: net faults + deadlines (latency under chaos A/B) =="
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --workers 2 --ab --net-fault net_delay@4,net_drop@8 --deadline 400 \
+  --rpc-timeout 5 --out "$NET_OUT"
+python -m tpu_trainer.tools.analyze "$NET_OUT" \
+  --compare "$NET_OUT" --reject-tol 0.0 --rpc-overhead-tol 5.0 \
+  --deadline-miss-tol 0.25 --stall-recovery-tol 15.0
+
 echo "chaos: full matrix clean ($OUT)"

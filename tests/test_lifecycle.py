@@ -1,0 +1,320 @@
+"""Request-lifecycle tests (ISSUE 16): cancellation, deadlines, and the
+terminal-state accounting they introduce.
+
+Tier-1, all CPU, deterministic ``steps`` clocks. The load-bearing
+assertions:
+
+- ``cancel`` retires a request mid-prefill, mid-decode, and
+  mid-speculation, and frees EXACTLY its paged KV blocks (pool
+  accounting returns to baseline with ``prefix_cache=False`` — no COW
+  refcounts to blur the count);
+- deadline expiry is swept at the iteration boundary: a request whose
+  deadline passes mid-chunked-prefill is retired at the next ``step()``
+  top, never mid-forward, and its blocks are reclaimed immediately;
+- the front-end conserves ``accepted == finished + cancelled +
+  deadline_exceeded`` at drain with ``in_flight == 0``;
+- ``Request.deadline`` crosses the RPC wire losslessly (identity field,
+  not runtime state).
+
+Same tiny config as test_frontend/test_worker ON PURPOSE: the jitted
+engine step is memoised per frozen config, so this module reuses the
+compile those modules already paid.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT
+from tpu_trainer.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+)
+from tpu_trainer.serving.remote import request_from_wire, request_to_wire
+from tpu_trainer.serving.scheduler import TERMINAL_STATES
+
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+                dtype="float32", param_dtype="float32")
+BLOCK = 8
+# prefix_cache OFF: cancelled blocks must return to the pool at the
+# cancel, not linger as evictable prefix entries — exact accounting.
+ENGINE_KW = dict(block_size=BLOCK, attention="reference",
+                 prefix_cache=False, max_batch=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _steps_engine(params, **kw):
+    """Engine on an injected iteration clock: ``now`` IS the step count,
+    so deadlines are exact integers and the tests are deterministic."""
+    merged = dict(ENGINE_KW, **kw)
+    eng = ServingEngine(params, CFG, **merged)
+    eng.clock = lambda: float(eng._iters)
+    eng._t0 = 0.0
+    return eng
+
+
+def _req(rid, prompt_len=20, max_new=12, deadline=None, seed=None):
+    rs = np.random.RandomState(1000 + rid)
+    return Request(
+        rid=rid,
+        prompt=rs.randint(1, CFG.vocab_size, size=prompt_len).tolist(),
+        max_new_tokens=max_new,
+        sampling=SamplingParams(seed=seed if seed is not None else rid),
+        deadline=deadline)
+
+
+def _drain(eng, max_iters=10_000):
+    out = []
+    for _ in range(max_iters):
+        if not eng.scheduler.has_work():
+            return out
+        out.extend(eng.step())
+    raise AssertionError("engine did not drain")
+
+
+class TestCancel:
+    def test_cancel_waiting_request_never_touches_the_pool(self, params):
+        eng = _steps_engine(params)
+        base = eng.cache_state.pool.free_blocks
+        req = _req(0)
+        eng.scheduler.add(req)
+        assert eng.cancel(0)
+        assert req.status == "cancelled"
+        assert req.finished_at is not None
+        assert eng.cache_state.pool.free_blocks == base
+        assert not eng.scheduler.has_work()
+        assert eng.stats["cancelled"] == 1
+
+    def test_cancel_mid_chunked_prefill_frees_exactly_its_blocks(
+            self, params):
+        eng = _steps_engine(params, prefill_chunk_tokens=BLOCK)
+        pool = eng.cache_state.pool
+        base = pool.free_blocks
+        req = _req(0, prompt_len=3 * BLOCK, max_new=8)
+        eng.scheduler.add(req)
+        eng.step()                         # one 8-token chunk resident
+        assert req.prefilling()            # still mid-prefill
+        held = base - pool.free_blocks
+        assert held > 0                    # the partial prefill holds blocks
+        assert eng.cancel(0)
+        assert req.status == "cancelled"
+        assert req.generated == []         # never reached decode
+        assert pool.free_blocks == base    # all of them came back, at once
+        assert not eng.scheduler.has_work()
+
+    def test_cancel_mid_decode_frees_blocks_others_unaffected(self, params):
+        eng = _steps_engine(params)
+        pool = eng.cache_state.pool
+        base = pool.free_blocks
+        survivor, victim = _req(0, max_new=10), _req(1, max_new=10)
+        want = [list(r.generated) for r in
+                ServingEngine(params, CFG, **ENGINE_KW).run(
+                    [_req(0, max_new=10)], time_mode="steps")]
+        eng.scheduler.add(survivor)
+        eng.scheduler.add(victim)
+        while not victim.generated:        # decode has started
+            eng.step()
+        assert eng.cancel(1)
+        assert victim.status == "cancelled"
+        free_after_cancel = pool.free_blocks
+        _drain(eng)
+        assert survivor.status == "finished"
+        # The survivor's stream is what it would have been alone, and
+        # the pool returns exactly to baseline once it finishes.
+        assert [list(survivor.generated)] == want
+        assert free_after_cancel < base    # survivor still held blocks
+        assert pool.free_blocks == base
+        assert eng.stats["cancelled"] == 1 and eng.stats["finished"] == 1
+
+    def test_cancel_mid_speculation_frees_blocks_and_controller(
+            self, params):
+        eng = _steps_engine(params, spec="ngram")
+        pool = eng.cache_state.pool
+        base = pool.free_blocks
+        # Repetitive prompts: the ngram proposer actually drafts, so the
+        # cancel lands with a speculative tail in flight.
+        motif = [5, 9, 2, 7]
+        reqs = [Request(rid=i, prompt=motif * 4, max_new_tokens=16,
+                        sampling=SamplingParams(seed=i)) for i in range(2)]
+        for r in reqs:
+            eng.scheduler.add(r)
+        while not reqs[1].generated:
+            eng.step()
+        eng.step()                          # at least one verify step
+        assert eng.cancel(1)
+        assert reqs[1].status == "cancelled"
+        assert 1 not in eng.spec_decoder._ctl   # controller forgotten
+        _drain(eng)
+        assert reqs[0].status == "finished"
+        assert pool.free_blocks == base
+        assert eng.stats["cancelled"] == 1
+
+    def test_cancel_unknown_or_terminal_rid_is_false(self, params):
+        eng = _steps_engine(params)
+        req = _req(0, max_new=4)
+        eng.scheduler.add(req)
+        _drain(eng)
+        assert req.status == "finished"
+        assert not eng.cancel(0)            # already terminal
+        assert not eng.cancel(999)          # never existed
+        assert eng.stats["cancelled"] == 0
+
+
+class TestDeadline:
+    def test_expiry_mid_chunked_prefill_retires_at_boundary(self, params):
+        eng = _steps_engine(params, prefill_chunk_tokens=BLOCK)
+        pool = eng.cache_state.pool
+        base = pool.free_blocks
+        # 5 chunks of prefill, but the deadline passes after iteration 2:
+        # the sweep at the TOP of step 3 (now == 3 > 2) retires it before
+        # any forward — never mid-iteration.
+        req = _req(0, prompt_len=5 * BLOCK, max_new=8, deadline=2.0)
+        eng.scheduler.add(req)
+        _drain(eng)
+        assert req.status == "deadline_exceeded"
+        assert req.finished_at == 3.0       # the first boundary past 2.0
+        assert req.generated == []          # expired before decode
+        assert pool.free_blocks == base
+        assert eng.stats["deadline_exceeded"] == 1
+
+    def test_waiting_request_expires_without_admission(self, params):
+        eng = _steps_engine(params, max_batch=1)
+        # One hog fills the only slot; the queued request's deadline
+        # passes while it is still WAITING — it must expire in place,
+        # never having touched the cache.
+        hog = _req(0, max_new=16)
+        queued = _req(1, deadline=3.0)
+        eng.scheduler.add(hog)
+        eng.scheduler.add(queued)
+        _drain(eng)
+        assert hog.status == "finished"
+        assert queued.status == "deadline_exceeded"
+        assert queued.slot is None
+
+    def test_finishing_on_time_is_not_a_miss(self, params):
+        eng = _steps_engine(params)
+        req = _req(0, max_new=4, deadline=1e9)
+        eng.scheduler.add(req)
+        _drain(eng)
+        assert req.status == "finished"
+        s = eng.summary()
+        assert s["deadline_miss_rate"] == 0.0
+        assert s["deadline_miss_slack_p99"] == 0.0
+
+    def test_summary_metrics_only_when_deadlines_observed(self, params):
+        eng = _steps_engine(params)
+        eng.scheduler.add(_req(0, max_new=4))
+        _drain(eng)
+        s = eng.summary()
+        # No deadlines anywhere -> no miss metrics: the analyze gate
+        # must SKIP, not read a spurious 0.0.
+        assert "deadline_miss_rate" not in s
+
+    def test_expired_and_finished_margins_both_counted(self, params):
+        eng = _steps_engine(params)
+        reqs = [_req(0, max_new=4, deadline=1e9),
+                _req(1, prompt_len=3 * BLOCK, max_new=32, deadline=1.0)]
+        for r in reqs:
+            eng.scheduler.add(r)
+        _drain(eng)
+        assert reqs[0].status == "finished"
+        assert reqs[1].status == "deadline_exceeded"
+        s = eng.summary()
+        assert s["deadline_miss_rate"] == 0.5
+        assert s["deadline_miss_slack_p99"] > 0.0
+
+
+class TestFrontendLifecycle:
+    def _fe(self, params, **kw):
+        kw.setdefault("replicas", 2)
+        kw.setdefault("routing", "affinity")
+        kw.setdefault("time_mode", "steps")
+        merged = dict(ENGINE_KW, **kw)
+        return ServingFrontend(params, CFG, **merged)
+
+    def test_conservation_with_cancel_and_deadline(self, params):
+        fe = self._fe(params)
+        reqs = [_req(100 + i, prompt_len=16, max_new=10,
+                     deadline=4.0 if i == 2 else None) for i in range(6)]
+        for r in reqs:
+            assert fe.submit(r).accepted
+        for _ in range(2):
+            fe.step()
+        assert fe.cancel(101)
+        assert reqs[1].status == "cancelled"
+        fin = fe.drain()
+        s = fe.summary()
+        assert s["cancelled"] == 1 and s["deadline_exceeded"] == 1
+        assert s["accepted"] == (s["finished"] + s["cancelled"]
+                                 + s["deadline_exceeded"])
+        assert s["in_flight"] == 0
+        assert {r.rid for r in fin} == {r.rid for r in reqs
+                                        if r.status == "finished"}
+        assert all(r.status in TERMINAL_STATES for r in reqs)
+
+    def test_cancel_waiting_request_before_any_step(self, params):
+        fe = self._fe(params)
+        req = _req(200, max_new=8)
+        assert fe.submit(req).accepted
+        assert fe.cancel(200)               # still queued on its replica
+        assert req.status == "cancelled"
+        assert fe.drain() == []
+        s = fe.summary()
+        assert s["cancelled"] == 1 and s["in_flight"] == 0
+
+    def test_cancel_unknown_rejected_or_terminal_is_false(self, params):
+        fe = self._fe(params, max_queue_depth=1)
+        assert not fe.cancel(12345)         # never submitted
+        accepted, rejected = [], []
+        for i in range(8):
+            r = _req(300 + i, max_new=4)
+            (accepted if fe.submit(r).accepted else rejected).append(r)
+        assert rejected                     # the tiny queue bound tripped
+        assert not fe.cancel(rejected[0].rid)   # rejects are not in flight
+        fe.drain()
+        assert all(not fe.cancel(r.rid) for r in accepted)  # all terminal
+
+    def test_run_excludes_cancelled_from_return(self, params):
+        fe = self._fe(params)
+        reqs = [_req(400 + i, max_new=6) for i in range(4)]
+        # Cancel one mid-run from a submit-time hook: run() submits at
+        # arrival, so cancel after the first drain iteration via a
+        # wrapped step.
+        orig_step = fe.step
+        state = {"done": False}
+
+        def step_and_cancel():
+            out = orig_step()
+            if not state["done"]:
+                state["done"] = fe.cancel(401)
+            return out
+
+        fe.step = step_and_cancel
+        fin = fe.run(reqs)
+        assert state["done"]
+        assert 401 not in {r.rid for r in fin}
+        assert reqs[1].status == "cancelled"
+        s = fe.summary()
+        assert s["accepted"] == s["finished"] + s["cancelled"] == 4
+
+
+class TestDeadlineWire:
+    def test_deadline_round_trips_and_defaults_none(self):
+        req = Request(rid=3, prompt=[1, 2, 3], max_new_tokens=4,
+                      deadline=17.5)
+        back = request_from_wire(request_to_wire(req))
+        assert back.deadline == 17.5
+        bare = request_from_wire(request_to_wire(
+            Request(rid=4, prompt=[1], max_new_tokens=1)))
+        assert bare.deadline is None

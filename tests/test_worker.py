@@ -19,7 +19,13 @@ Tier-1 (not in conftest's _SLOW_MODULES), all on CPU in deterministic
   the dead worker's work over bit-identically (finished == accepted);
 - death detection: exit codes and heartbeat flatlines each reported
   exactly once; capacity grants spawn real processes and shrink drains
-  them.
+  them;
+- request-lifecycle hardening (ISSUE 16): per-call RPC deadlines
+  tighten from the compile-scale budget to ``rpc_timeout_s`` after the
+  first step response; a SIGSTOP'd worker (hung, not dead — no exit
+  code to poll) is fenced within that timeout and the fleet resumes
+  bit-identically; ``cancel`` and ``deadline`` cross the wire and the
+  mirrors retire identically to the in-process path.
 
 One module-scoped supervisor (two prewarmed workers, ``reset()``
 between tests) keeps the process-spawn cost to roughly one fleet
@@ -293,6 +299,82 @@ class TestDeathDetection:
         assert sup.poll_deaths() == []
 
 
+# --- per-call RPC deadlines and the transport fault shim -------------------
+
+class TestRpcTimeouts:
+    """Pure socketpair, no processes: the compile-scale timeout applies
+    only until the first step response; after that every call gets the
+    small per-call budget, and a peer that never answers raises
+    ``ReplicaDied`` instead of wedging the front-end."""
+
+    def _handle(self, **kw):
+        a, b = socket.socketpair()
+        return WorkerHandle(worker_id=0, proc=_FakeProc(), sock=a,
+                            **kw), a, b
+
+    def test_timeout_tightens_after_first_step_response(self):
+        h, a, b = self._handle(rpc_timeout_s=3.0, first_call_timeout_s=77.0)
+        try:
+            send_frame(b, {"id": 1, "ok": True, "result": {}})
+            h.rpc("ping")
+            assert a.gettimeout() == 77.0       # still compile-scale
+            assert not h.first_step_done        # ping is not a step
+            send_frame(b, {"id": 2, "ok": True,
+                           "result": {"deltas": [], "load": {}}})
+            h.rpc("step")
+            assert h.first_step_done
+            send_frame(b, {"id": 3, "ok": True, "result": {}})
+            h.rpc("ping")
+            assert a.gettimeout() == 3.0        # per-call from now on
+        finally:
+            a.close()
+            b.close()
+
+    def test_silent_peer_raises_replica_died_within_timeout(self):
+        h, a, b = self._handle(rpc_timeout_s=0.2, first_call_timeout_s=0.2)
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(ReplicaDied):
+                h.rpc("ping")                   # peer never answers
+            assert time.perf_counter() - t0 < 5.0
+        finally:
+            a.close()
+            b.close()
+
+    def test_net_delay_is_transparent_and_one_shot(self, monkeypatch):
+        monkeypatch.setenv(remote.NET_DELAY_MS_ENV, "1")
+        h, a, b = self._handle()
+        try:
+            h.net_fault = "net_delay"
+            send_frame(b, {"id": 1, "ok": True, "result": {}})
+            assert h.rpc("ping") == {}          # delayed, not failed
+            assert h.net_fault is None          # consumed
+        finally:
+            a.close()
+            b.close()
+
+    @pytest.mark.parametrize("kind", ["net_drop", "net_garble", "net_hang"])
+    def test_lethal_net_faults_raise_replica_died(self, kind):
+        h, a, b = self._handle(rpc_timeout_s=0.2, first_call_timeout_s=0.2)
+        try:
+            h.net_fault = kind
+            with pytest.raises(ReplicaDied):
+                h.rpc("ping")
+        finally:
+            a.close()
+            b.close()
+
+    def test_supervisor_heartbeat_timeout_defaults_finite(self, tmp_path):
+        # Flatline detection is ON unless explicitly opted out: a hung
+        # worker must never be invisible by default.
+        s = WorkerSupervisor(None, None, run_dir=str(tmp_path / "a"))
+        assert s.heartbeat_timeout_s == remote.DEFAULT_HEARTBEAT_TIMEOUT_S
+        assert s.heartbeat_timeout_s is not None
+        opt_out = WorkerSupervisor(None, None, run_dir=str(tmp_path / "b"),
+                                   heartbeat_timeout_s=None)
+        assert opt_out.heartbeat_timeout_s is None
+
+
 # --- the real fleet: bit-identity, failover, resize ------------------------
 
 class TestWorkerFleet:
@@ -330,6 +412,45 @@ class TestWorkerFleet:
         assert s["transport"] == "rpc"
         assert s["finished"] == s["accepted"] == len(fin)
         assert s["worker_deaths"] == 0
+        sup.reset()
+
+    def test_cancel_rpc_retires_on_worker_and_mirror(self, params, sup):
+        fe = self._fe(params, sup)
+        reqs = _mixed_requests(6, max_new=8)
+        for r in reqs:
+            assert fe.submit(r).accepted
+        for _ in range(3):
+            fe.step()
+        assert fe.cancel(reqs[2].rid)
+        assert reqs[2].status == "cancelled"     # mirror synced at cancel
+        assert not fe.cancel(reqs[2].rid)        # already terminal
+        fin = fe.drain()
+        s = fe.summary()
+        # The cancelled rid never reappears in a later step delta: it is
+        # counted exactly once and excluded from the finished stream.
+        assert reqs[2].rid not in {r.rid for r in fin}
+        assert s["cancelled"] == 1
+        assert s["accepted"] == s["finished"] + s["cancelled"]
+        assert s["in_flight"] == 0
+        sup.reset()
+
+    def test_deadline_expiry_crosses_the_wire(self, params, sup):
+        fe = self._fe(params, sup)
+        reqs = _mixed_requests(6)
+        # Expires at iteration 3 (the first boundary past 2.0), long
+        # before its 6 decode tokens are done — on the WORKER's engine;
+        # the delta must carry the terminal state back to the mirror.
+        reqs[1].deadline = 2.0
+        fin = fe.run(reqs)
+        s = fe.summary()
+        assert reqs[1].status == "deadline_exceeded"
+        assert reqs[1].finished_at == 3.0
+        assert len(reqs[1].generated) < reqs[1].max_new_tokens
+        assert reqs[1].rid not in {r.rid for r in fin}
+        assert s["deadline_exceeded"] == 1
+        assert s["deadline_miss_rate"] == 1.0    # 1 deadline, 1 miss
+        assert s["accepted"] == s["finished"] + s["deadline_exceeded"]
+        assert s["in_flight"] == 0
         sup.reset()
 
     def test_torn_frame_closes_connection_not_worker(self, sup):
@@ -413,6 +534,57 @@ class TestWorkerFleet:
         s = fe.summary()
         assert s["replicas_live"] == 1 and s["retired_replicas"] == 1
         assert sup.live_worker_count() == 1     # drained worker torn down
+        sup.reset()
+
+
+# --- the hung-RPC fence (SIGSTOP drill) ------------------------------------
+
+class TestWorkerHang:
+    """SIGSTOP is the nasty failure mode: the process is hung, not dead
+    — no exit code to poll, heartbeats just stop. The per-call RPC
+    timeout is the only detector; the supervisor then FENCES the suspect
+    (SIGKILL works on stopped processes) so it can never wake up and
+    write again, and the standard export/failover path resumes every
+    stream bit-identically on the survivor."""
+
+    def test_hung_worker_fenced_streams_resume_bit_identical(
+            self, params, sup, monkeypatch):
+        eng = ServingEngine(params, CFG, **ENGINE_KW)
+        want = {r.rid: list(r.generated)
+                for r in eng.run(_mixed_requests(), time_mode="steps")}
+
+        fe = ServingFrontend(params, CFG, replica_factory=sup, replicas=2,
+                             routing="affinity", time_mode="steps")
+        victim = fe._rendezvous(
+            fe._affinity_key(_mixed_requests()[0].prompt), fe._live()).rid
+        monkeypatch.setenv("TPU_TRAINER_FAULT_REPLICA", str(victim))
+        # Warm EVERY worker under the compile-scale first-call budget
+        # (a fresh pool member pays its jit compile here), then tighten
+        # the per-call timeout — exactly what a production deploy does
+        # after warm-up. Warm requests go straight to the replicas so
+        # the front-end's accounting stays clean for the assertions.
+        for h in fe._replicas:
+            rep = h.engine
+            rep.submit(Request(rid=900 + h.rid, prompt=[1, 2, 3],
+                               max_new_tokens=1, sampling=SamplingParams(),
+                               arrival_time=0.0))
+            while rep.has_work():
+                rep.step()
+            rep._handle.rpc_timeout_s = 1.5
+            assert rep._handle.first_step_done  # warm: small budget now on
+        fenced_before = sup.n_fenced
+        with faults.plan("worker_hang@3"):
+            fin = fe.run(_mixed_requests())
+        s = fe.summary()
+        assert {r.rid: list(r.generated) for r in fin} == want
+        assert s["finished"] == s["accepted"] == len(fin)
+        assert s["worker_deaths"] == 1
+        assert s["replicas_live"] == 1
+        assert sup.n_fenced == fenced_before + 1
+        assert sup.live_worker_count() == 1      # the suspect is really gone
+        # The stall the front-end actually observed is bounded by the
+        # per-call timeout (plus fence overhead, generous CI margin).
+        assert 1.0 <= s["stall_recovery_max_s"] < 10.0
         sup.reset()
 
 

@@ -159,6 +159,10 @@ class ServingEngine:
         self._k_cap = 1
         self._iters = 0
         self._t0 = None
+        # Per deadline-carrying terminal request: terminal_time - deadline
+        # (positive = the deadline was missed by that much). Feeds the
+        # deadline_miss_* summary fields.
+        self._deadline_margins: List[float] = []
         self.stats: Dict[str, float] = {
             "prefill_iters": 0, "decode_iters": 0, "idle_iters": 0,
             "prefill_tokens": 0, "prefill_chunks": 0,
@@ -166,6 +170,10 @@ class ServingEngine:
             "occupancy_sum": 0.0, "occupancy_samples": 0,
             "occupancy_max": 0.0,
             "spec_steps": 0, "spec_drafted": 0, "spec_accepted": 0,
+            # Per-terminal-state request counts ("failed" has no current
+            # producer — see scheduler.TERMINAL_STATES).
+            "finished": 0, "cancelled": 0, "deadline_exceeded": 0,
+            "failed": 0,
         }
 
     def reset_stats(self) -> None:
@@ -180,6 +188,7 @@ class ServingEngine:
         self.scheduler.prompt_tokens = 0
         self.cache_state.n_prefix_evictions = 0
         self.wall_elapsed = 0.0
+        self._deadline_margins = []
         if self.spec_decoder is not None:
             self.spec_decoder.reset_stats()
         for k in self.stats:
@@ -188,29 +197,72 @@ class ServingEngine:
     # -- one engine iteration ----------------------------------------------
 
     def step(self) -> List[Request]:
-        """Run one scheduler iteration. Returns requests finished now."""
+        """Run one scheduler iteration. Returns the requests that reached
+        a terminal state this iteration: finished streams, plus anything
+        the deadline sweep retired at the boundary (their blocks are
+        already back in the pool)."""
         self._iters += 1
+        terminal = self._expire_deadlines()
         kind, reqs = self.scheduler.schedule()
         if kind == "idle":
             self.stats["idle_iters"] += 1
-            return []
+            return terminal
         if kind == "prefill":
-            finished = self._forward(reqs, prefill=True)
+            terminal += self._forward(reqs, prefill=True)
             self.stats["prefill_iters"] += 1
         elif self.spec_decoder is not None:
-            finished = self._spec_decode()
+            terminal += self._spec_decode()
             self.stats["decode_iters"] += 1
         else:
             reqs = self.scheduler.ensure_decode_blocks()
             if not reqs:          # everything preempted itself back out
-                return []
-            finished = self._forward(reqs, prefill=False)
+                return terminal
+            terminal += self._forward(reqs, prefill=False)
             self.stats["decode_iters"] += 1
         occ = self.cache_state.pool.occupancy
         self.stats["occupancy_sum"] += occ
         self.stats["occupancy_samples"] += 1
         self.stats["occupancy_max"] = max(self.stats["occupancy_max"], occ)
-        return finished
+        return terminal
+
+    def _expire_deadlines(self) -> List[Request]:
+        """The iteration-boundary deadline sweep (scheduler.expire) plus
+        the engine-side bookkeeping a terminal request needs. Skips the
+        clock read entirely when nothing carries a deadline, so runs
+        without deadlines are untouched."""
+        s = self.scheduler
+        if (all(r.deadline is None for r in s.waiting)
+                and all(r.deadline is None for r in s.running)):
+            return []
+        now = self._now()
+        expired = s.expire(now)
+        for r in expired:
+            r.finished_at = now
+            if self.spec_decoder is not None:
+                self.spec_decoder.forget(r)
+            self.stats["deadline_exceeded"] += 1
+            self._observe_deadline(r, now)
+        return expired
+
+    def _observe_deadline(self, r: Request, now: float) -> None:
+        if r.deadline is not None:
+            self._deadline_margins.append(now - r.deadline)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel a queued or in-flight request NOW: terminal status
+        ``cancelled``, slot and paged KV blocks (speculative tails
+        included) back in the pool before this call returns — not at the
+        next drain. False if ``rid`` is not queued or in flight here.
+        The request never appears in a later ``step()`` return; callers
+        doing conservation accounting count the cancel themselves."""
+        req = self.scheduler.cancel(rid)
+        if req is None:
+            return False
+        req.finished_at = self._now()
+        if self.spec_decoder is not None:
+            self.spec_decoder.forget(req)
+        self.stats["cancelled"] += 1
+        return True
 
     def _forward(self, reqs: List[Request], *, prefill: bool) -> List[Request]:
         slots = self.max_batch
@@ -303,6 +355,8 @@ class ServingEngine:
             ):
                 r.finished_at = now
                 self.scheduler.retire(r)
+                self.stats["finished"] += 1
+                self._observe_deadline(r, now)
                 finished.append(r)
         return finished
 
@@ -412,6 +466,8 @@ class ServingEngine:
                 r.finished_at = now
                 sd.forget(r)
                 self.scheduler.retire(r)
+                self.stats["finished"] += 1
+                self._observe_deadline(r, now)
                 finished.append(r)
             else:
                 self.scheduler.shrink_spec_blocks(r)
@@ -478,7 +534,10 @@ class ServingEngine:
         when the clock passes its ``arrival_time``. ``time_mode="wall"``
         measures arrivals in seconds; ``"steps"`` measures them in engine
         iterations — fully deterministic, for tests and replay checks.
-        Returns the finished requests in input order."""
+        Returns the finished requests in input order; requests that
+        ended cancelled or past their deadline are dropped from the
+        return (their terminal state lives on the Request objects the
+        caller already holds, and in ``summary()``)."""
         if time_mode not in ("wall", "steps"):
             raise ValueError(f"time_mode={time_mode!r}")
         pending = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
@@ -504,8 +563,8 @@ class ServingEngine:
             if self._iters >= max_iters:
                 raise RuntimeError(f"engine did not drain in {max_iters} iters")
         self.wall_elapsed = self.clock() - t_start
-        by_rid = {r.rid: r for r in done}
-        return [by_rid[r.rid] for r in requests]
+        by_rid = {r.rid: r for r in done if r.status == "finished"}
+        return [by_rid[r.rid] for r in requests if r.rid in by_rid]
 
     def summary(self) -> Dict[str, float]:
         s = dict(self.stats)
@@ -524,6 +583,15 @@ class ServingEngine:
         s["outstanding_tokens"] = self.outstanding_tokens
         s["oldest_wait_s"] = (
             self.oldest_wait_age() if self.scheduler.waiting else 0.0)
+        if self._deadline_margins:
+            # Miss slack = how far past its deadline a deadline-carrying
+            # request ended (0 for the ones that made it). Absent when
+            # the run carried no deadlines, so analyze gates SKIP.
+            margins = np.asarray(self._deadline_margins)
+            slack = np.maximum(margins, 0.0)
+            s["deadline_miss_rate"] = float(np.mean(margins > 0))
+            s["deadline_miss_slack_p50"] = float(np.percentile(slack, 50))
+            s["deadline_miss_slack_p99"] = float(np.percentile(slack, 99))
         if self.spec_decoder is not None:
             s["spec_accept_mean"] = (
                 s["spec_accepted"] / max(1, int(s["spec_steps"])))

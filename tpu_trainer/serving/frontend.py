@@ -66,6 +66,25 @@ and drive the same ``kill_replica`` failover as ``replica_kill`` —
 dead-worker state is reconstructed from the front-end-side request
 mirrors, so queued AND in-flight requests resume bit-identically on
 the survivors.
+
+Request lifecycle: beyond finishing, an accepted request can be
+**cancelled** (``cancel(rid)`` — effective on waiting AND running
+requests, freeing its paged KV blocks immediately on in-process and
+RPC replicas alike via the ``cancel`` RPC verb) or can miss its
+**deadline** (``Request.deadline``, front-end clock domain; expiry is
+swept at each engine iteration boundary). Both are terminal states
+counted separately from ``finished``; conservation becomes ``accepted
+== finished + cancelled + deadline_exceeded`` at drain. Hung — not
+dead — workers (the ``worker_hang`` SIGSTOP fault, or a real wedge)
+are caught by per-call RPC timeouts: the blocked call raises
+``ReplicaDied``, the supervisor FENCES the suspect (SIGKILL, so a
+paused process can never wake up and keep serving a replica the
+front-end already failed over), and recovery reuses the exact
+``kill_replica`` export/resubmit path — so resumed streams stay
+bit-identical and the front-end stall is bounded by the configured
+RPC timeout. One-shot transport faults (``net_delay`` / ``net_drop``
+/ ``net_garble`` / ``net_hang``) arm the same machinery for chaos
+drills.
 """
 
 from __future__ import annotations
@@ -122,6 +141,9 @@ class LocalReplica:
 
     def step(self) -> List[Request]:
         return self.engine.step()
+
+    def cancel(self, rid: int) -> bool:
+        return self.engine.cancel(rid)
 
     def has_work(self) -> bool:
         return self.engine.scheduler.has_work()
@@ -242,10 +264,19 @@ class ServingFrontend:
         self.wall_elapsed = 0.0
         self.submit_results: Dict[int, SubmitResult] = {}
         self._wait_samples: List[float] = []
+        # Wall-clock seconds the front-end lost to a replica step that
+        # ended in ReplicaDied (hung-RPC fence or death mid-call) — the
+        # observable stall a caller sees before failover kicks in.
+        self._stall_samples: List[float] = []
+        # finished_at - deadline per deadline-carrying terminal request
+        # (cancels excluded): >0 is a miss, the fleet-level mirror of
+        # the per-engine deadline accounting.
+        self._deadline_margins: List[float] = []
         self.stats: Dict[str, float] = {
             "submitted": 0, "accepted": 0, "rejected": 0,
             "rejected_queue_full": 0, "rejected_wait_watermark": 0,
-            "finished": 0,
+            "finished": 0, "cancelled": 0, "deadline_exceeded": 0,
+            "failed": 0,
             "failover_events": 0, "failed_over_requests": 0,
             "worker_deaths": 0,
             "grows": 0, "shrinks": 0, "retired_replicas": 0,
@@ -395,6 +426,39 @@ class ServingFrontend:
         if routed != "failover":
             self.stats["accepted"] += 1
 
+    # -- cancellation ------------------------------------------------------
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel an accepted request wherever it currently lives. The
+        request may have moved since submit (failover, shrink), so every
+        live replica is asked; the one holding it retires it on the spot
+        and frees its paged KV blocks — mid-prefill, mid-decode, or
+        mid-speculation. Returns False for unknown, rejected, or
+        already-terminal rids. A replica that dies during the cancel RPC
+        is failed over (its requests move to survivors) and the scan
+        restarts so the moved request is still found."""
+        res = self.submit_results.get(rid)
+        if res is None or not res.accepted:
+            return False
+        for _attempt in range(2):
+            retry = False
+            for h in list(self._replicas):
+                if not h.alive:
+                    continue
+                try:
+                    ok = h.engine.cancel(rid)
+                except ReplicaDied:
+                    self.stats["worker_deaths"] += 1
+                    self.kill_replica(h.rid)
+                    retry = True
+                    break
+                if ok:
+                    self.stats["cancelled"] += 1
+                    return True
+            if not retry:
+                break
+        return False
+
     # -- failover ----------------------------------------------------------
 
     def kill_replica(self, rid: Optional[int] = None) -> int:
@@ -479,10 +543,12 @@ class ServingFrontend:
 
     def step(self) -> List[Request]:
         """One front-end iteration: fire armed ``replica_kill`` /
-        ``worker_kill`` faults, settle worker-process deaths into
-        failover, probe the capacity file, reap drained replicas, then
-        advance every live replica with work by one engine step.
-        Returns the requests finished this iteration (all replicas)."""
+        ``worker_kill`` / ``worker_hang`` / ``net_*`` faults, settle
+        worker-process deaths into failover, probe the capacity file,
+        reap drained replicas, then advance every live replica with
+        work by one engine step. Returns the requests finished this
+        iteration (all replicas); other terminal outcomes (cancelled,
+        deadline_exceeded, failed) are counted into ``stats``."""
         self._iters += 1
         if faults.fire("replica_kill", self._iters):
             self.kill_replica()
@@ -494,6 +560,20 @@ class ServingFrontend:
                 raise RuntimeError(
                     "worker_kill fault armed but replicas are in-process")
             self._supervisor.sigkill()
+        if faults.fire("worker_hang", self._iters):
+            # A hang, not a death: SIGSTOP freezes the worker mid-
+            # service. Nothing exits, so poll_deaths sees no exit code;
+            # the next step RPC blocks until the per-call timeout, the
+            # supervisor fences (SIGKILLs) the suspect, and the same
+            # kill_replica failover resumes its streams — the stall is
+            # bounded by the configured RPC timeout.
+            if self._supervisor is None:
+                raise RuntimeError(
+                    "worker_hang fault armed but replicas are in-process")
+            self._supervisor.sigstop()
+        for kind in ("net_delay", "net_drop", "net_garble", "net_hang"):
+            if faults.fire(kind, self._iters):
+                self._arm_net_fault(kind)
         self._settle_worker_deaths()
         if self.capacity_file and self._iters % self.capacity_probe_every == 0:
             self._probe_capacity()
@@ -501,21 +581,53 @@ class ServingFrontend:
         finished: List[Request] = []
         for h in self._replicas:
             if h.alive and h.engine.has_work():
+                t_step = time.perf_counter()
                 try:
                     out = h.engine.step()
                 except ReplicaDied:
-                    # Died mid-RPC: any tokens the worker generated but
-                    # never reported are simply re-generated on the
-                    # survivor — sampling is keyed (seed, token_index),
-                    # so the resumed stream is unchanged.
+                    # Died — or was fenced as hung — mid-RPC: any tokens
+                    # the worker generated but never reported are simply
+                    # re-generated on the survivor — sampling is keyed
+                    # (seed, token_index), so the resumed stream is
+                    # unchanged. The elapsed time on the failed call is
+                    # the front-end's observable stall.
+                    self._stall_samples.append(
+                        time.perf_counter() - t_step)
                     self.stats["worker_deaths"] += 1
                     self.kill_replica(h.rid)
                     continue
-                h.finished += len(out)
-                finished.extend(out)
+                for r in out:
+                    if r.status == "finished":
+                        h.finished += 1
+                        finished.append(r)
+                    else:
+                        self.stats[r.status] += 1
+                    self._observe_deadline(r)
         self.stats["finished"] += len(finished)
         self._sample_load()
         return finished
+
+    def _arm_net_fault(self, kind: str) -> None:
+        """Arm a one-shot transport fault on one replica's next RPC.
+        Victim selection mirrors ``kill_replica``: the
+        ``TPU_TRAINER_FAULT_REPLICA`` env override, else the highest-id
+        live replica. In-process replicas have no transport to fault."""
+        live = self._live()
+        raw = os.environ.get("TPU_TRAINER_FAULT_REPLICA")
+        rid = int(raw) if raw is not None else max(h.rid for h in live)
+        victims = [h for h in live if h.rid == rid]
+        if not victims:
+            raise ValueError(f"replica {rid} is not alive")
+        rep = victims[0].engine
+        if not hasattr(rep, "inject_net_fault"):
+            raise RuntimeError(
+                f"{kind} fault armed but replica {rid} is in-process")
+        rep.inject_net_fault(kind)
+
+    def _observe_deadline(self, r: Request) -> None:
+        if (r.deadline is not None and r.status != "cancelled"
+                and r.finished_at is not None):
+            self._deadline_margins.append(r.finished_at - r.deadline)
 
     def _settle_worker_deaths(self) -> None:
         if self._supervisor is None:
@@ -557,8 +669,11 @@ class ServingFrontend:
         """Replay an open-loop trace (same contract as ``ServingEngine.
         run``): each request is SUBMITTED — routing + admission — when
         the clock passes its ``arrival_time``; rejected requests simply
-        never finish (their ``SubmitResult`` is in ``submit_results``).
-        Returns the finished requests in input order."""
+        never finish (their ``SubmitResult`` is in ``submit_results``),
+        and cancelled / deadline-expired requests are likewise absent
+        from the return — their terminal state lives on the request
+        object and in ``stats``. Returns the finished requests in
+        input order."""
         pending = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
         t_start = self.clock()
         if self.time_mode == "wall" and self._t0 is None:
@@ -583,23 +698,27 @@ class ServingFrontend:
                     f"front-end did not drain in {max_iters} iters")
         self._reap_draining()
         self.wall_elapsed = self.clock() - t_start
-        by_rid = {r.rid: r for r in done}
+        by_rid = {r.rid: r for r in done if r.status == "finished"}
         return [by_rid[r.rid] for r in requests if r.rid in by_rid]
 
     # -- telemetry ---------------------------------------------------------
 
     def summary(self) -> Dict[str, object]:
         """Fleet-level accounting. Conservation invariants (tested):
-        ``accepted + rejected == submitted`` always, and ``finished ==
-        accepted`` once drained — failover moves a request, it never
-        duplicates or drops one."""
+        ``accepted + rejected == submitted`` always, and ``accepted ==
+        finished + cancelled + deadline_exceeded`` once drained —
+        failover moves a request, it never duplicates or drops one, and
+        every accepted request reaches exactly one terminal state."""
         s: Dict[str, object] = {
             k: v for k, v in self.stats.items()
             if not k.startswith("imbalance_")}
         live = self._live()
         s["replicas_live"] = len(live)
         s["replicas_total"] = len(self._replicas)
-        s["in_flight"] = int(self.stats["accepted"] - self.stats["finished"])
+        s["in_flight"] = int(
+            self.stats["accepted"] - self.stats["finished"]
+            - self.stats["cancelled"] - self.stats["deadline_exceeded"]
+            - self.stats["failed"])
         s["reject_rate"] = (
             self.stats["rejected"] / max(1, self.stats["submitted"]))
         s["queue_depth"] = sum(h.engine.queue_depth for h in live)
@@ -642,4 +761,14 @@ class ServingFrontend:
                                  for h in self._replicas)
                           else "inproc")
         s["worker_deaths"] = int(self.stats["worker_deaths"])
+        if self._stall_samples:
+            s["stall_recovery_max_s"] = float(max(self._stall_samples))
+        if self._supervisor is not None:
+            s["fenced"] = int(getattr(self._supervisor, "n_fenced", 0))
+        if self._deadline_margins:
+            margins = np.asarray(self._deadline_margins)
+            slack = np.maximum(margins, 0.0)
+            s["deadline_miss_rate"] = float(np.mean(margins > 0))
+            s["deadline_miss_slack_p50"] = float(np.percentile(slack, 50))
+            s["deadline_miss_slack_p99"] = float(np.percentile(slack, 99))
         return s

@@ -178,6 +178,7 @@ def request_to_wire(req: Request) -> dict:
         "sampling": dataclasses.asdict(req.sampling),
         "arrival_time": float(req.arrival_time),
         "eos_id": None if req.eos_id is None else int(req.eos_id),
+        "deadline": None if req.deadline is None else float(req.deadline),
         "generated": [int(t) for t in req.generated],
         "token_times": [float(t) for t in req.token_times],
         "blocks_registered": int(req._blocks_registered),
@@ -195,6 +196,7 @@ def request_from_wire(d: dict) -> Request:
         sampling=SamplingParams(**d["sampling"]),
         arrival_time=float(d["arrival_time"]),
         eos_id=d.get("eos_id"),
+        deadline=d.get("deadline"),
     )
     req.generated = list(d.get("generated", ()))
     req.token_times = list(d.get("token_times", ()))
@@ -252,6 +254,54 @@ def load_params_npz(path: str) -> dict:
     return out
 
 
+# -- transport fault shim (the net_* chaos kinds, utils/faults.py) ---------
+
+NET_DELAY_MS_ENV = "TPU_TRAINER_NET_DELAY_MS"
+
+
+def _inject_net_fault(kind: str, sock: socket.socket) -> None:
+    """Apply one armed fault to the framed transport, in place of (or
+    before) the next exchange. ``net_delay`` just adds latency and lets
+    the call proceed; the other kinds sabotage the stream the way a real
+    network does and raise ``ReplicaDied`` so the caller takes the exact
+    failover path an organic transport failure takes."""
+    if kind == "net_delay":
+        time.sleep(float(os.environ.get(NET_DELAY_MS_ENV, "50")) / 1e3)
+        return
+    if kind == "net_garble":
+        # A correctly-framed body that is not UTF-8: the worker's
+        # recv_frame raises FrameError, drops ONLY that connection, and
+        # goes back to accept; our read then sees the close.
+        try:
+            sock.sendall(_HEADER.pack(16) + b"\xff" * 16)
+            sock.recv(1)
+        except OSError:
+            pass
+        raise ReplicaDied("injected net_garble: stream poisoned")
+    if kind == "net_drop":
+        # Torn frame: promise a body, deliver nothing, close. The peer
+        # sees EOF mid-frame (FrameError) and drops the connection.
+        try:
+            sock.sendall(_HEADER.pack(64))
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise ReplicaDied("injected net_drop: frame torn mid-send")
+    if kind == "net_hang":
+        # Dead air: nothing sent, nothing will arrive — the per-call
+        # timeout is the only way out (the hung-RPC fence drill without
+        # needing to SIGSTOP anything).
+        try:
+            sock.recv(1)
+        except OSError as e:            # socket.timeout is an OSError
+            raise ReplicaDied(f"injected net_hang: {e}") from e
+        raise ReplicaDied("injected net_hang: unexpected data")
+    raise ValueError(f"unknown net fault kind {kind!r}")
+
+
 # -- the remote replica adapter --------------------------------------------
 
 
@@ -268,12 +318,36 @@ class WorkerHandle:
     rid: Optional[int] = None           # front-end replica id, once assigned
     retired: bool = False               # deliberately shut down, not a death
     next_id: int = 0
+    # Per-call socket deadlines: every call before the first completed
+    # ``step`` may sit behind the worker's engine build or first-step
+    # compile, so it gets the compile-scale budget; once a step response
+    # has arrived the worker is warm and every later call gets the small
+    # per-call timeout — a hung worker then stalls the caller for at most
+    # ``rpc_timeout_s``, not 600 s.
+    rpc_timeout_s: float = 30.0
+    first_call_timeout_s: float = 600.0
+    first_step_done: bool = False
+    # One-shot armed transport fault (a net_* kind) for the next rpc().
+    net_fault: Optional[str] = None
 
     def rpc(self, method: str, params: Optional[dict] = None):
         if self.sock is None:
             raise ReplicaDied(f"worker {self.worker_id}: no connection")
         self.next_id += 1
-        return rpc(self.sock, self.next_id, method, params or {})
+        timeout = (self.rpc_timeout_s if self.first_step_done
+                   else self.first_call_timeout_s)
+        try:
+            self.sock.settimeout(timeout)
+        except OSError as e:
+            raise ReplicaDied(
+                f"worker {self.worker_id}: socket unusable: {e}") from e
+        fault, self.net_fault = self.net_fault, None
+        if fault is not None:
+            _inject_net_fault(fault, self.sock)
+        result = rpc(self.sock, self.next_id, method, params or {})
+        if method == "step":
+            self.first_step_done = True
+        return result
 
     def close(self, *, grace_s: float = 5.0) -> None:
         if self.sock is not None:
@@ -328,7 +402,16 @@ class RemoteReplica:
         try:
             result = self._handle.rpc(method, params)
         except ReplicaDied:
+            # The hung-RPC fence: a timed-out or poisoned exchange makes
+            # this replica SUSPECT — maybe dead, maybe wedged, maybe
+            # about to answer late. The supervisor kills the process so
+            # the state is unambiguous BEFORE the caller re-runs the
+            # mirrors elsewhere (a wedged worker waking up later and
+            # double-generating is the failure this prevents); the
+            # raise then rides the exact replica_kill failover path.
             self.dead = True
+            if self._supervisor is not None:
+                self._supervisor.fence(self._handle)
             raise
         load = result.get("load")
         if load is not None:
@@ -354,17 +437,44 @@ class RemoteReplica:
             req = self._reqs.get(d["rid"])
             if req is None:
                 continue
-            req.generated.extend(d["gen"])
-            req.token_times.extend(d["times"])
-            req.first_token_at = d["first"]
-            req.preemptions = d["preempt"]
-            req.prefix_hit_tokens = d["hit"]
-            req.spec_drafted, req.spec_accepted, req.spec_steps = d["spec"]
-            req.status = d["status"]
+            self._apply_delta(req, d)
             if d["done"]:
-                req.finished_at = d["finished_at"]
                 finished.append(self._reqs.pop(d["rid"]))
         return finished
+
+    def _apply_delta(self, req: Request, d: dict) -> None:
+        req.generated.extend(d["gen"])
+        req.token_times.extend(d["times"])
+        req.first_token_at = d["first"]
+        req.preemptions = d["preempt"]
+        req.prefix_hit_tokens = d["hit"]
+        req.spec_drafted, req.spec_accepted, req.spec_steps = d["spec"]
+        req.status = d["status"]
+        if d["done"]:
+            req.finished_at = d["finished_at"]
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel on the worker: its engine frees the request's slot and
+        blocks before the response is framed, the terminal delta lands
+        on the mirror HERE, and the rid never appears in a later step
+        delta — so in-process and RPC replicas retire identically."""
+        if rid not in self._reqs:
+            return False
+        result = self._rpc("cancel", {"rid": rid, "now": self.clock()})
+        if not result.get("cancelled"):
+            return False
+        req = self._reqs.pop(rid)
+        d = result.get("delta")
+        if d:
+            self._apply_delta(req, d)
+        else:
+            req.status = "cancelled"
+        return True
+
+    def inject_net_fault(self, kind: str) -> None:
+        """Arm a one-shot transport fault (a ``net_*`` chaos kind) on
+        this replica's next RPC."""
+        self._handle.net_fault = kind
 
     def has_work(self) -> bool:
         return bool(self._load["has_work"])
@@ -448,6 +558,20 @@ class RemoteReplica:
 
 # -- supervision -----------------------------------------------------------
 
+# The worker beats its heartbeat on every RPC-loop wakeup (0.5 s select
+# timeout; writes throttled to 0.2 s), so a healthy worker's beat stream
+# never gaps past ~1 s while it is idle or reachable. A worker is only
+# ever busy inside an RPC handler the front-end is itself blocked on —
+# the supervisor cannot be polling a worker mid-compile — so 20x the
+# wakeup cadence is far past any legitimate gap while still fencing a
+# wedged-but-alive worker out of the box (the SIGSTOP failure mode exit
+# codes can never catch).
+_WORKER_LOOP_WAKEUP_S = 0.5
+DEFAULT_HEARTBEAT_TIMEOUT_S = 20 * _WORKER_LOOP_WAKEUP_S
+# Sentinel: "derive the default" (None must stay a meaningful value —
+# the explicit detection opt-out).
+_AUTO = "auto"
+
 
 class WorkerSupervisor:
     """Launches and watches worker processes; IS the front-end's
@@ -470,11 +594,19 @@ class WorkerSupervisor:
 
     def __init__(self, params, config, *, engine_kwargs=None,
                  run_dir: Optional[str] = None,
-                 heartbeat_timeout_s: Optional[float] = None,
+                 heartbeat_timeout_s=_AUTO,
                  connect_timeout_s: float = 240.0,
+                 rpc_timeout_s: float = 30.0,
+                 first_step_timeout_s: float = 600.0,
                  tcp: bool = False):
+        if heartbeat_timeout_s == _AUTO:
+            heartbeat_timeout_s = DEFAULT_HEARTBEAT_TIMEOUT_S
+        # None = explicit opt-out of flatline detection (exit codes only).
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.connect_timeout_s = connect_timeout_s
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.first_step_timeout_s = float(first_step_timeout_s)
+        self.n_fenced = 0
         self.tcp = tcp
         if run_dir is None or len(run_dir) > 70:
             # unix socket paths are capped near 108 bytes — keep ours short
@@ -557,17 +689,42 @@ class WorkerSupervisor:
         return wid, proc, log_path
 
     def _handshake(self, wid: int, proc, log_path: str) -> WorkerHandle:
-        try:
-            sock = self._connect(wid, proc)
-            handle = WorkerHandle(worker_id=wid, proc=proc, sock=sock,
-                                  log_path=log_path)
-            hello = handle.rpc("hello")
-        except Exception:
-            proc.kill()
-            raise
-        handle.block_size = int(hello["block_size"])
-        handle.pid = int(hello["pid"])
-        return handle
+        # Bounded retry with backoff — for the IDEMPOTENT handshake only.
+        # A torn accept or ECONNRESET between connect and hello is a
+        # transient (the worker is still coming up and still listening);
+        # reconnecting and re-saying hello is always safe. Non-idempotent
+        # in-flight calls (step/submit) are NEVER retried anywhere: their
+        # response may have been lost AFTER the worker advanced, and a
+        # replay would double-generate — those errors fence and fail
+        # over instead (RemoteReplica._rpc).
+        last: Optional[Exception] = None
+        for attempt in range(3):
+            try:
+                sock = self._connect(wid, proc)
+            except Exception:
+                proc.kill()
+                raise
+            handle = WorkerHandle(
+                worker_id=wid, proc=proc, sock=sock, log_path=log_path,
+                rpc_timeout_s=self.rpc_timeout_s,
+                first_call_timeout_s=self.first_step_timeout_s)
+            try:
+                hello = handle.rpc("hello")
+            except ReplicaDied as e:
+                last = e
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                time.sleep(0.05 * (2 ** attempt))
+                continue
+            handle.block_size = int(hello["block_size"])
+            handle.pid = int(hello["pid"])
+            return handle
+        proc.kill()
+        raise RuntimeError(
+            f"worker {wid}: handshake failed after 3 attempts "
+            f"(see {log_path}): {last}")
 
     def _connect(self, wid: int, proc) -> socket.socket:
         deadline = time.monotonic() + self.connect_timeout_s
@@ -586,7 +743,10 @@ class WorkerSupervisor:
                 else:
                     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                     s.connect(sock_path)
-                s.settimeout(600.0)   # first step pays the worker's compile
+                # Initial budget only: WorkerHandle.rpc re-arms the
+                # timeout per call (compile-scale until the first step
+                # response, small per-call after — see WorkerHandle).
+                s.settimeout(self.first_step_timeout_s)
                 return s
             except (OSError, FileNotFoundError, ValueError):
                 if time.monotonic() > deadline:
@@ -619,6 +779,44 @@ class WorkerSupervisor:
         except Exception:
             pass
         return rid
+
+    def sigstop(self, rid: Optional[int] = None) -> int:
+        """Freeze one worker process (the ``worker_hang`` fault):
+        SIGSTOP leaves it alive — exit-code detection can never see it —
+        but wedged, so its heartbeat flatlines and any RPC to it hangs
+        until the per-call timeout fences it. Same targeting convention
+        as ``sigkill``."""
+        cands = {r: h for r, h in self._handles.items()
+                 if not h.retired and h.proc.poll() is None}
+        if not cands:
+            raise RuntimeError("no live workers to hang")
+        if rid is None:
+            raw = os.environ.get("TPU_TRAINER_FAULT_REPLICA")
+            rid = int(raw) if raw is not None else max(cands)
+        if rid not in cands:
+            raise ValueError(f"worker for replica {rid} is not alive")
+        os.kill(cands[rid].proc.pid, signal.SIGSTOP)
+        return rid
+
+    def fence(self, handle: WorkerHandle) -> None:
+        """Make a SUSPECT worker unambiguously dead. Called by
+        ``RemoteReplica._rpc`` when an exchange times out or the stream
+        poisons: the process may be wedged, half-connected, or about to
+        answer late — SIGKILL (which lands on a SIGSTOPped process too)
+        guarantees it can never wake up and double-generate after its
+        requests have been re-run on a survivor. The death report is
+        swallowed (``_reported_dead``): the caller that hit the error IS
+        the failover path, so ``poll_deaths`` must not re-report it."""
+        self.n_fenced += 1
+        if handle.rid is not None:
+            self._reported_dead.add(handle.rid)
+        if handle.retired or handle.proc.poll() is not None:
+            return
+        try:
+            handle.proc.kill()
+            handle.proc.wait(timeout=10)
+        except Exception:
+            pass
 
     def poll_deaths(self) -> List[int]:
         """Replica ids whose worker died since the last poll (exit code

@@ -37,6 +37,14 @@ mirrors (no device syncs):
   to the uninterrupted one, chunked or not.
 - **Retirement**: EOS or max_new_tokens; the request's block references
   drop the same iteration (shared blocks survive in the prefix index).
+- **Cancellation / deadlines**: ``cancel`` retires a waiting or running
+  request on the spot with a terminal ``cancelled`` status (blocks and
+  slot freed immediately for running requests; waiting ones just leave
+  the queue), and ``expire`` sweeps every request whose ``deadline`` has
+  passed into ``deadline_exceeded`` the same way. The engine runs the
+  sweep at the top of each step, so expiry lands at an iteration
+  boundary — never mid-dispatch — and a chunked prefill in progress
+  simply stops at its current chunk.
 """
 
 from __future__ import annotations
@@ -46,6 +54,13 @@ from collections import deque
 from typing import Deque, List, Optional, Tuple
 
 from tpu_trainer.serving.paged_cache import PagedKVCache
+
+# Every status a request can end in. "finished" is the only one that
+# produced a complete stream; "failed" is reserved for unrecoverable
+# per-request errors (no current producer, but the accounting schema
+# carries it so adding one is not a schema change).
+TERMINAL_STATES = frozenset(
+    {"finished", "cancelled", "deadline_exceeded", "failed"})
 
 
 @dataclasses.dataclass
@@ -79,10 +94,16 @@ class Request:
     sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     arrival_time: float = 0.0
     eos_id: Optional[int] = None
+    # Absolute completion deadline in the engine's clock domain (front-end
+    # iteration number in ``steps`` mode, seconds since run start in
+    # ``wall``). None = no deadline. Expiry is swept at iteration
+    # boundaries: strictly past the deadline -> ``deadline_exceeded``.
+    deadline: Optional[float] = None
 
     # Runtime state (engine/scheduler-owned).
     generated: List[int] = dataclasses.field(default_factory=list)
-    status: str = "waiting"            # waiting | running | finished
+    # waiting | running | finished | cancelled | deadline_exceeded | failed
+    status: str = "waiting"
     slot: Optional[int] = None
     preemptions: int = 0
     first_token_at: Optional[float] = None
@@ -374,9 +395,51 @@ class Scheduler:
         self.n_preemptions += 1
         self.waiting.appendleft(victim)
 
-    def retire(self, req: Request) -> None:
+    def retire(self, req: Request, status: str = "finished") -> None:
+        assert status in TERMINAL_STATES, status
         self._vacate(req)
-        req.status = "finished"
+        req.status = status
+
+    def cancel(self, rid: int, *, status: str = "cancelled"):
+        """Retire request ``rid`` NOW with a terminal status, wherever it
+        sits: a waiting request just leaves the queue (it holds no
+        blocks), a running one is vacated — slot and every non-shared
+        block back in the pool this instant, not at drain. Returns the
+        request, or None if ``rid`` is not queued or in flight (already
+        terminal, or never submitted here)."""
+        assert status in TERMINAL_STATES, status
+        for req in self.waiting:
+            if req.rid == rid:
+                self.waiting.remove(req)
+                req.status = status
+                return req
+        for req in self.running:
+            if req.rid == rid:
+                self.retire(req, status)
+                req.prefill_cursor = 0
+                req.prefill_target = 0
+                req.prefill_chunk = 0
+                return req
+        return None
+
+    def expire(self, now: float) -> List[Request]:
+        """Retire every waiting/running request strictly past its
+        deadline as ``deadline_exceeded``; returns them. Called by the
+        engine at the top of each step, so expiry always lands at an
+        iteration boundary — a mid-chunked-prefill request keeps the
+        chunks already fed and simply never schedules again (its blocks
+        are freed here, like any other retirement)."""
+        expired: List[Request] = []
+        for req in [r for r in self.waiting
+                    if r.deadline is not None and now > r.deadline]:
+            self.waiting.remove(req)
+            req.status = "deadline_exceeded"
+            expired.append(req)
+        for req in [r for r in self.running
+                    if r.deadline is not None and now > r.deadline]:
+            self.retire(req, "deadline_exceeded")
+            expired.append(req)
+        return expired
 
     def _vacate(self, req: Request) -> None:
         self.cache.release(req.slot)

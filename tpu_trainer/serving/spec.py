@@ -461,6 +461,13 @@ class SpecDecoder:
         self.proposer.rewind(req, accepted)
 
     def forget(self, req) -> None:
+        """Drop per-request speculative state. Called on EVERY terminal
+        transition — finish, preemption-free cancel, deadline expiry —
+        so a cancelled request's draft-length controller (and, via the
+        scheduler's vacate, its speculative KV tail blocks) can never
+        leak: the proposer's slot mapping is keyed (slot, rid) and
+        ``rewind`` guards against reuse, so forgetting here is the only
+        cleanup a mid-speculation retire needs."""
         self._ctl.pop(req.rid, None)
 
     def reset_stats(self) -> None:

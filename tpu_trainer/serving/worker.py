@@ -49,7 +49,7 @@ from tpu_trainer.serving.remote import (
     request_to_wire,
     send_frame,
 )
-from tpu_trainer.serving.scheduler import Request
+from tpu_trainer.serving.scheduler import Request, TERMINAL_STATES
 from tpu_trainer.utils.flight_recorder import HeartbeatWriter
 
 
@@ -136,7 +136,7 @@ class WorkerServer:
             "times": [float(t) for t in req.token_times[sent:]],
             "first": req.first_token_at,
             "status": req.status,
-            "done": req.status == "finished",
+            "done": req.status in TERMINAL_STATES,
             "finished_at": req.finished_at,
             "preempt": req.preemptions,
             "hit": req.prefix_hit_tokens,
@@ -164,13 +164,28 @@ class WorkerServer:
             deltas: List[dict] = []
             for rid, req in list(self._reqs.items()):
                 if len(req.generated) > self._sent[rid] or (
-                        req.status == "finished"):
+                        req.status in TERMINAL_STATES):
                     deltas.append(self._delta(req))
                     self._sent[rid] = len(req.generated)
-                    if req.status == "finished":
+                    if req.status in TERMINAL_STATES:
                         del self._reqs[rid]
                         del self._sent[rid]
             return {"deltas": deltas, "load": self._load()}
+        if method == "cancel":
+            # Terminal on the spot: the engine frees the request's slot
+            # and blocks before this response is framed, and the request
+            # never appears in a later step delta — the front-end mirror
+            # applies the delta returned HERE instead.
+            self._now_value = float(msg.get("now", self._now_value))
+            rid = int(msg["rid"])
+            ok = self.engine.cancel(rid)
+            delta = None
+            if ok and rid in self._reqs:
+                req = self._reqs.pop(rid)
+                delta = self._delta(req)
+                del self._sent[rid]
+            return {"cancelled": bool(ok), "delta": delta,
+                    "load": self._load()}
         if method == "export":
             reqs = self.engine.export_requests(
                 waiting_only=bool(msg.get("waiting_only", False)))

@@ -288,7 +288,19 @@ def summarize(records: List[dict]) -> dict:
             "load_imbalance_mean", "load_imbalance_max",
             "failover_events", "failed_over_requests", "wait_age_p99_s",
             "transport", "workers", "worker_deaths",
+            "finished", "cancelled", "deadline_exceeded",
             ) if f.get(k) is not None}
+        # Lifecycle / chaos metrics (deadline misses, hung-RPC stalls,
+        # fence counts) live on whichever lane carried the deadline or
+        # fault — scan for the newest record with each, like the RPC
+        # overhead scan below.
+        for k in ("deadline_miss_rate", "deadline_miss_slack_p50",
+                  "deadline_miss_slack_p99", "stall_recovery_max_s",
+                  "fenced"):
+            r = next((x for x in reversed(fronts)
+                      if x.get(k) is not None), None)
+            if r is not None:
+                report["frontend"][k] = r.get(k)
         # The RPC-overhead fields live on the cross-process A/B lane's
         # record, which may not be the newest (a worker_kill lane often
         # follows it) — scan for the newest rpc-transport record.
@@ -578,6 +590,22 @@ def render(report: dict) -> List[str]:
             f" max {_fmt(fe.get('load_imbalance_max'))}"
             f" | failovers {fe.get('failover_events') or 0}"
             f" ({fe.get('failed_over_requests') or 0} reqs)")
+        if (fe.get("cancelled") or fe.get("deadline_exceeded")
+                or fe.get("deadline_miss_rate") is not None):
+            line = (f"frontend lifecycle: {fe.get('finished') or 0} finished,"
+                    f" {fe.get('cancelled') or 0} cancelled,"
+                    f" {fe.get('deadline_exceeded') or 0} deadline_exceeded")
+            if fe.get("deadline_miss_rate") is not None:
+                line += (
+                    f" | deadline miss rate"
+                    f" {_fmt(fe.get('deadline_miss_rate'), 3)} slack p99"
+                    f" {_fmt(fe.get('deadline_miss_slack_p99'), 3)}s")
+            lines.append(line)
+        if fe.get("stall_recovery_max_s") is not None:
+            lines.append(
+                f"frontend max failover stall"
+                f" {_fmt(fe.get('stall_recovery_max_s'), 2)}s"
+                f" ({fe.get('fenced') or 0} fenced)")
         if fe.get("transport") == "rpc":
             line = (f"frontend transport rpc ({fe.get('workers')} worker"
                     f" processes, {fe.get('worker_deaths') or 0} deaths)")
@@ -646,7 +674,9 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             moe_drop_tol: float = 0.0,
             spec_accept_tol: float = 0.0,
             reject_tol: float = 0.05,
-            rpc_overhead_tol: float = 1.0) -> List[dict]:
+            rpc_overhead_tol: float = 1.0,
+            deadline_miss_tol: float = 0.05,
+            stall_recovery_tol: float = 30.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -732,6 +762,18 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
       the submit-to-first-token delta vs the identical in-process fleet
       on the same trace) must stay under ``rpc_overhead_tol`` seconds.
       SKIP on in-process runs (no rpc record, or no A/B delta).
+    - ``frontend_deadline_miss`` is ABSOLUTE against a fixed ceiling:
+      the fraction of deadline-carrying terminal requests that finished
+      (or expired) past their deadline must stay under
+      ``deadline_miss_tol`` — an SLO is a promise, not a baseline-
+      relative metric. SKIP when the run carried no deadlines (the
+      metric is only emitted when deadline margins were observed).
+    - ``frontend_stall_recovery`` is ABSOLUTE against a fixed budget:
+      the longest single front-end stall on a replica step that ended
+      in failover (a hung worker fenced at the RPC timeout, or a death
+      mid-call) must stay under ``stall_recovery_tol`` seconds — the
+      per-call timeout exists precisely to bound this. SKIP when the
+      run had no such stall.
     """
     def get(report, *keys):
         cur = report
@@ -1005,6 +1047,46 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "tolerance_s": rpc_overhead_tol,
             "absolute": True,
         })
+
+    # Deadline misses and hung-RPC stalls are ABSOLUTE against fixed
+    # budgets: an SLO miss rate or a failover stall that was already bad
+    # in the baseline must not grandfather itself in. Both SKIP when the
+    # run never observed the metric (no deadlines attached; no failover
+    # stall) — emission is conditional in frontend.summary() for exactly
+    # this reason.
+    new_miss = get(new, "frontend", "deadline_miss_rate")
+    if new_miss is None:
+        verdicts.append({"metric": "frontend_deadline_miss",
+                         "verdict": "SKIP",
+                         "base": get(base, "frontend", "deadline_miss_rate"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "frontend_deadline_miss",
+            "verdict": "FAIL" if new_miss > deadline_miss_tol + eps
+            else "PASS",
+            "base": get(base, "frontend", "deadline_miss_rate"),
+            "new": round(new_miss, 5),
+            "tolerance_frac": deadline_miss_tol,
+            "absolute": True,
+        })
+    new_stall = get(new, "frontend", "stall_recovery_max_s")
+    if new_stall is None:
+        verdicts.append({"metric": "frontend_stall_recovery",
+                         "verdict": "SKIP",
+                         "base": get(base, "frontend",
+                                     "stall_recovery_max_s"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "frontend_stall_recovery",
+            "verdict": "FAIL" if new_stall > stall_recovery_tol + eps
+            else "PASS",
+            "base": get(base, "frontend", "stall_recovery_max_s"),
+            "new": round(new_stall, 5),
+            "tolerance_s": stall_recovery_tol,
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -1105,6 +1187,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "in-process fleet, serve_bench --workers --ab) "
                              "exceeds this many seconds (default 1.0); SKIP "
                              "on in-process runs")
+    parser.add_argument("--deadline-miss-tol", type=float, default=0.05,
+                        help="ABSOLUTE gate on request deadlines: FAIL if "
+                             "more than this fraction of deadline-carrying "
+                             "requests finished or expired past their "
+                             "deadline (default 0.05); SKIP when the run "
+                             "attached no deadlines")
+    parser.add_argument("--stall-recovery-tol", type=float, default=30.0,
+                        help="ABSOLUTE gate on failover stalls: FAIL if "
+                             "the longest front-end stall on a replica "
+                             "step that ended in failover (hung worker "
+                             "fenced at the RPC timeout, or death mid-"
+                             "call) exceeds this many seconds (default "
+                             "30); SKIP when the run had no such stall")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -1132,7 +1227,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             moe_drop_tol=args.moe_drop_tol,
             spec_accept_tol=args.spec_accept_tol,
             reject_tol=args.reject_tol,
-            rpc_overhead_tol=args.rpc_overhead_tol)
+            rpc_overhead_tol=args.rpc_overhead_tol,
+            deadline_miss_tol=args.deadline_miss_tol,
+            stall_recovery_tol=args.stall_recovery_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

@@ -49,6 +49,31 @@ programmatically (tests) or from the ``--inject_fault`` debug flag:
   The death must be detected by exit code, and the front-end's mirror
   state must fail the worker's queued and in-flight requests over to
   the surviving processes bit-identically.
+- ``worker_hang@N``   — chaos lane, serving tier: at front-end
+  iteration N the worker supervisor ``SIGSTOP``\\ s one worker process
+  (same victim convention as ``worker_kill``) — a hang, not a death:
+  nothing exits and no exit code appears. The front-end's next step
+  RPC must hit its per-call timeout, the supervisor must FENCE the
+  suspect (SIGKILL, so it can never wake up and keep serving), and the
+  standard failover must resume its streams bit-identically — with the
+  front-end stall bounded by the configured RPC timeout.
+- ``net_delay@N``     — chaos lane, serving tier: one replica's next
+  RPC is delayed by ``TPU_TRAINER_NET_DELAY_MS`` milliseconds (default
+  50) before being sent — transient network latency; the call must
+  still succeed (no failover, just a slower iteration).
+- ``net_drop@N``      — chaos lane, serving tier: one replica's next
+  RPC tears its connection mid-frame (a length header with no body,
+  then close) — the transport must surface ``ReplicaDied`` and the
+  front-end must fail the replica over; the worker must survive the
+  torn frame (it poisons only the connection).
+- ``net_garble@N``    — chaos lane, serving tier: one replica's next
+  RPC sends a well-framed but non-JSON payload — the worker must drop
+  the poisoned connection (not crash), and the front-end must fail the
+  replica over.
+- ``net_hang@N``      — chaos lane, serving tier: one replica's next
+  RPC sends nothing and waits for a response that never comes — the
+  per-call timeout must bound the stall and drive the same fence +
+  failover as ``worker_hang``.
 - ``return_host@N``   — chaos lane: at step N rank 0 writes a capacity
   grant to the supervisor's capacity file (``TPU_TRAINER_CAPACITY_FILE``),
   simulating a preempted host coming back — the grow probe
@@ -81,7 +106,8 @@ from typing import List, Optional, Tuple
 KINDS = frozenset(
     {"nan_loss", "loss_spike", "kill", "kill_in_save", "truncate_meta",
      "corrupt_shard", "sigterm", "kill_host", "hang_host",
-     "preempt_notice", "return_host", "replica_kill", "worker_kill"}
+     "preempt_notice", "return_host", "replica_kill", "worker_kill",
+     "worker_hang", "net_delay", "net_drop", "net_garble", "net_hang"}
 )
 
 # Kinds that act on :func:`target_host`'s rank(s) only.
