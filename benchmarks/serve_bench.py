@@ -49,6 +49,14 @@ hit rates, reject rate, load imbalance, failover counts) gated by
 ``analyze.py --reject-tol`` and its categorical affinity-vs-random
 check; the drain gate asserts every ACCEPTED request finished.
 
+Observability rides every lane by default: per-request span timelines
+(``kind="span"``), serve-loop time-series samples (``kind="serve_ts"``)
+and incident records (``kind="incident"``, with ``--incident-dir``
+flight-recorder dumps) land in ``--out`` next to the lane records, the
+bench self-analyzes its own ``--out`` to stderr, span conservation is a
+lane gate, ``--profile-trace DIR`` captures a ``jax.profiler`` trace of
+the serve loop, and ``--no-trace`` is the bit-identity A/B.
+
     python benchmarks/serve_bench.py [--requests 32] [--concurrency 8]
     python benchmarks/serve_bench.py --workload adversarial --ab --update-md
     python benchmarks/serve_bench.py --workload repetitive --spec ngram --ab
@@ -281,7 +289,24 @@ def main(argv=None) -> int:
     p.add_argument("--tpot-p99-gate", type=float, default=0.0,
                    help="seconds; > 0 gates p99 TPOT and exits 1 past it "
                         "(--smoke defaults this to 60)")
+    p.add_argument("--profile-trace", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the timed "
+                        "serving iterations into DIR/<lane> (each engine "
+                        "iteration wrapped in a StepTraceAnnotation "
+                        "labelled 'serve'); single-engine lanes only")
+    p.add_argument("--incident-dir", default=None, metavar="DIR",
+                   help="front-end lanes: dump flight-recorder incident "
+                        "reports (failover / worker death / fence / "
+                        "drain failure) into DIR/<lane>/...")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable span tracing + serve_ts telemetry (the "
+                        "bit-identity A/B for 'tracing is free'; on by "
+                        "default)")
     args = p.parse_args(argv)
+
+    if args.profile_trace and (args.replicas > 0 or args.workers > 0):
+        p.error("--profile-trace profiles the single-engine serve loop; "
+                "drop --replicas/--workers to use it")
 
     if args.workers > 0:
         if args.replicas > 0 and args.replicas != args.workers:
@@ -327,6 +352,7 @@ def main(argv=None) -> int:
     from tpu_trainer.serving.engine import (
         ServingEngine, poisson_trace, request_metrics)
     from tpu_trainer.serving.scheduler import Request, SamplingParams
+    from tpu_trainer.serving.tracing import span_record
     from tpu_trainer.utils.logging import SCHEMA_VERSION
 
     plo, phi = (int(x) for x in args.prompt_len.split(","))
@@ -466,6 +492,8 @@ def main(argv=None) -> int:
         draft_params, draft_config = draft_from_target(
             params, cfg, args.spec_draft_layers)
 
+    obs_records = []   # kind:"span"/"serve_ts" riding --out next to lanes
+
     def run_lane(lane, prefill_chunk, prefix_cache, trace_fn=make_trace,
                  wl=None, spec="off"):
         engine = ServingEngine(
@@ -476,10 +504,23 @@ def main(argv=None) -> int:
             prefix_cache=prefix_cache,
             spec=spec, spec_k=args.spec_k,
             draft_params=draft_params, draft_config=draft_config,
+            trace=not args.no_trace,
         )
         engine.run(trace_fn())        # warm-up: compiles every step shape
         engine.reset_stats()
-        finished = engine.run(trace_fn())
+        prof = None
+        if args.profile_trace:
+            from tpu_trainer.utils.profiling import WindowedTrace
+
+            # One trace dir per lane; the window opens on the first timed
+            # iteration (compiles were paid by the warm-up run above).
+            prof = WindowedTrace(os.path.join(args.profile_trace, lane),
+                                 start=0, num_steps=64, label="serve")
+        try:
+            finished = engine.run(trace_fn(), profiler=prof)
+        finally:
+            if prof is not None:
+                prof.close()
         summary = engine.summary()
         lat = request_metrics(finished)
         drained = all(len(r.generated) >= min(r.max_new_tokens, 1)
@@ -530,6 +571,17 @@ def main(argv=None) -> int:
                     float(np.percentile(series, 50)), 5)
                 record[f"{name}_p99_s"] = round(
                     float(np.percentile(series, 99)), 5)
+        if engine.tracer.enabled:
+            cons = engine.tracer.conservation()
+            record["span_events"] = len(engine.tracer)
+            record["span_conservation_ok"] = bool(cons["ok"])
+            for rid in engine.tracer.rids():
+                obs_records.append(span_record(
+                    rid, engine.tracer.events(rid), lane=lane))
+        for ts in engine.serve_ts:
+            ts = dict(ts)
+            ts["lane"] = lane
+            obs_records.append(ts)
         return record, drained, finished
 
     # --- lanes --------------------------------------------------------------
@@ -641,6 +693,7 @@ def main(argv=None) -> int:
         adv_record, adv_drained, _ = run_lane(
             "smoke_adversarial", args.block_size, True,
             trace_fn=adversarial_trace, wl="adversarial")
+        records.append(adv_record)
         _print_record(adv_record)
         print(json.dumps(adv_record), flush=True)
         if args.out:
@@ -662,6 +715,7 @@ def main(argv=None) -> int:
         spec_rec, spec_drained, spec_fin = run_lane(
             "smoke_spec", 0, False,
             trace_fn=repetitive_trace, wl="repetitive", spec="ngram")
+        records.extend((off_rec, spec_rec))
         for rec in (off_rec, spec_rec):
             _print_record(rec)
             print(json.dumps(rec), flush=True)
@@ -695,6 +749,7 @@ def main(argv=None) -> int:
             bt_rec, bt_drained, _ = run_lane(
                 "smoke_byte_trace", 0, False, trace_fn=byte_trace_fn,
                 wl="trace:byte_trace.jsonl", spec="ngram")
+            records.append(bt_rec)
             _print_record(bt_rec)
             print(json.dumps(bt_rec), flush=True)
             if args.out:
@@ -705,9 +760,40 @@ def main(argv=None) -> int:
         else:
             failures.append(f"missing checked-in trace {byte_trace}")
 
+    # Span conservation is a lane-level gate, same rank as drain: a lane
+    # whose tracer holds an opened-but-never-terminated timeline dropped
+    # an event somewhere in the scheduler/engine path.
+    for rec in records:
+        if rec.get("span_conservation_ok") is False:
+            failures.append(
+                f"span conservation broken in lane {rec['lane']}")
+
+    if args.out:
+        if obs_records:
+            with open(args.out, "a") as fh:
+                for rec in obs_records:
+                    fh.write(json.dumps(rec) + "\n")
+        _analyze_out(args.out)
+
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
     return 1 if failures else 0
+
+
+def _analyze_out(path: str) -> None:
+    """Self-analysis: run the offline analyzer over the JSONL this bench
+    just wrote, reporting to stderr (stdout keeps the per-lane JSON
+    lines for drivers that parse them)."""
+    from tpu_trainer.tools import analyze as analyze_lib
+
+    try:
+        report = analyze_lib.summarize(analyze_lib.load_records(path))
+    except (Exception, SystemExit) as e:
+        print(f"serve_bench: self-analysis failed: {e}", file=sys.stderr,
+              flush=True)
+        return
+    for line in analyze_lib.render(report):
+        print(f"serve_bench: {line}", file=sys.stderr, flush=True)
 
 
 def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
@@ -740,6 +826,7 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
 
     from tpu_trainer.serving.engine import request_metrics
     from tpu_trainer.serving.frontend import ServingFrontend
+    from tpu_trainer.serving.tracing import span_record
     from tpu_trainer.utils import faults
     from tpu_trainer.utils.logging import SCHEMA_VERSION
 
@@ -758,18 +845,23 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         sup_kwargs = {}
         if args.rpc_timeout > 0:
             sup_kwargs["rpc_timeout_s"] = args.rpc_timeout
-        sup = WorkerSupervisor(params, cfg, engine_kwargs=engine_kwargs,
-                               **sup_kwargs)
+        # Worker processes build their engines from this spec, so the
+        # tracing switch must travel with it for the fleet to agree.
+        sup = WorkerSupervisor(
+            params, cfg,
+            engine_kwargs=dict(engine_kwargs, trace=not args.no_trace),
+            **sup_kwargs)
         sup.prewarm(args.replicas)
         supervisors.append(sup)
         return sup
 
-    def build(routing, sup=None):
+    def build(routing, sup=None, incident_dir=None):
         return ServingFrontend(
             params, cfg, replicas=args.replicas, routing=routing,
             max_queue_depth=args.max_queue or max(args.requests, 1),
             wait_watermark=args.wait_watermark or None,
             seed=args.seed, replica_factory=sup,
+            trace=not args.no_trace, incident_dir=incident_dir,
             **engine_kwargs,
         )
 
@@ -784,7 +876,13 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
                 r.deadline = r.arrival_time + args.deadline
         return trace
 
+    obs_records = []   # kind:"span"/"serve_ts"/"incident" riding --out
+
     def run_lane(lane, routing, fault_spec=None, transport="inproc"):
+        # Incidents dump per lane (the warm-up front-end gets no dir: a
+        # compile-run artifact would shadow the timed drill's dump).
+        inc_dir = (os.path.join(args.incident_dir, lane)
+                   if args.incident_dir else None)
         if transport == "rpc":
             # Warm-up compiles inside the worker PROCESSES, so they must
             # survive into the timed run: reset() rebuilds each worker's
@@ -793,10 +891,10 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             sup = make_supervisor()
             build(routing, sup).run(make_trace())
             sup.reset()
-            fe = build(routing, sup)
+            fe = build(routing, sup, incident_dir=inc_dir)
         else:
             build(routing).run(make_trace())   # warm-up: compiles shapes
-            fe = build(routing)
+            fe = build(routing, incident_dir=inc_dir)
         if fault_spec:
             with faults.plan(fault_spec):
                 finished = fe.run(timed_trace())
@@ -868,6 +966,22 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
                     float(np.percentile(series, 50)), 5)
                 record[f"{name}_p99_s"] = round(
                     float(np.percentile(series, 99)), 5)
+        if "span_conservation_ok" in s:
+            record["span_events"] = int(s["span_events"])
+            record["span_conservation_ok"] = bool(s["span_conservation_ok"])
+        record["incidents"] = int(s["incidents"])
+        if fe.tracer.enabled:
+            for rid in fe.tracer.rids():
+                obs_records.append(span_record(
+                    rid, fe.tracer.events(rid), lane=lane))
+        for ts in fe.serve_ts:
+            ts = dict(ts)
+            ts["lane"] = lane
+            obs_records.append(ts)
+        for inc in fe.incidents:
+            inc = dict(inc)
+            inc["lane"] = lane
+            obs_records.append(inc)
         ttfts = {r.rid: r.first_token_at - r.arrival_time
                  for r in finished if r.first_token_at is not None}
         return record, drained, ttfts
@@ -956,14 +1070,19 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
 
     if args.out:
         with open(args.out, "a") as fh:
-            for rec in records:
+            for rec in records + obs_records:
                 fh.write(json.dumps(rec) + "\n")
+        _analyze_out(args.out)
 
     failures = []
     if not all_drained:
         failures.append(
             "front-end did not drain (an accepted request never reached "
             "a terminal state: finished/cancelled/deadline_exceeded)")
+    for rec in records:
+        if rec.get("span_conservation_ok") is False:
+            failures.append(
+                f"span conservation broken in lane {rec['lane']}")
     if args.ttft_p99_gate > 0:
         p99 = records[-1].get("ttft_p99_s")
         if p99 is None or p99 > args.ttft_p99_gate:
@@ -1002,6 +1121,10 @@ def _print_frontend_record(r) -> None:
     if "ttft_p50_s" in r:
         print(f"TTFT    p50 {r['ttft_p50_s'] * 1e3:8.1f} ms   "
               f"p99 {r['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
+    if r.get("span_conservation_ok") is not None or r.get("incidents"):
+        print(f"spans   {r.get('span_events', 0)} events, conservation "
+              f"{'ok' if r.get('span_conservation_ok') else 'BROKEN'} | "
+              f"incidents {r.get('incidents', 0)}", flush=True)
     per = "/".join(f"{p['prefix_hit_rate']:.2f}" for p in r["per_replica"])
     print(f"fleet   prefix hit rate {r['prefix_hit_rate']:.2f} "
           f"(per-replica {per}) | reject rate {r['reject_rate']:.3f} "

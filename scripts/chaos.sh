@@ -116,7 +116,7 @@ echo "== chaos: replica_kill (serving front-end) =="
 python benchmarks/serve_bench.py --smoke --workload shared_prefix \
   --replicas 3 --ab --replica-kill 6 --out "$SERVE_OUT"
 python -m tpu_trainer.tools.analyze "$SERVE_OUT" \
-  --compare "$SERVE_OUT" --reject-tol 0.0
+  --compare "$SERVE_OUT" --reject-tol 0.0 --queue-wait-tol 60.0
 
 # 8. Cross-process serving (serving/worker.py): the same drill with each
 #    replica a real OS process behind the RPC socket — a worker is
@@ -129,7 +129,8 @@ echo "== chaos: worker_kill (cross-process serving) =="
 python benchmarks/serve_bench.py --smoke --workload shared_prefix \
   --workers 2 --ab --worker-kill 6 --out "$WORKER_OUT"
 python -m tpu_trainer.tools.analyze "$WORKER_OUT" \
-  --compare "$WORKER_OUT" --reject-tol 0.0 --rpc-overhead-tol 5.0
+  --compare "$WORKER_OUT" --reject-tol 0.0 --rpc-overhead-tol 5.0 \
+  --queue-wait-tol 60.0
 
 # 9. Hung worker (SIGSTOP, not SIGKILL): nothing exits, so the per-call
 #    RPC timeout is the only thing standing between the front-end and an
@@ -142,7 +143,8 @@ echo "== chaos: worker_hang (hung-RPC fence) =="
 python benchmarks/serve_bench.py --smoke --workload shared_prefix \
   --workers 2 --worker-hang 6 --rpc-timeout 5 --out "$HANG_OUT"
 python -m tpu_trainer.tools.analyze "$HANG_OUT" \
-  --compare "$HANG_OUT" --reject-tol 0.0 --stall-recovery-tol 15.0
+  --compare "$HANG_OUT" --reject-tol 0.0 --stall-recovery-tol 15.0 \
+  --queue-wait-tol 60.0
 
 # 10. Network faults + deadlines: a transient delay (call must still
 #     succeed) and a torn frame (connection death -> failover) against a
@@ -158,6 +160,28 @@ python benchmarks/serve_bench.py --smoke --workload shared_prefix \
   --rpc-timeout 5 --out "$NET_OUT"
 python -m tpu_trainer.tools.analyze "$NET_OUT" \
   --compare "$NET_OUT" --reject-tol 0.0 --rpc-overhead-tol 5.0 \
-  --deadline-miss-tol 0.25 --stall-recovery-tol 15.0
+  --deadline-miss-tol 0.25 --stall-recovery-tol 15.0 --queue-wait-tol 60.0
+
+# 11. Incident flight recorder: the worker-kill drill again, this time
+#     asserting the OBSERVABILITY artifacts — the per-replica span-event
+#     ring must have dumped an atomic crash_report.json under the
+#     incident dir when the worker died, the span-conservation gate must
+#     PASS (failover moved the timelines, it didn't drop a terminal
+#     event), and the absolute queue-wait p99 gate must hold on the
+#     run's own span records.
+INC_OUT="$OUT/incident.jsonl"
+INC_DIR="$OUT/incidents"
+rm -f "$INC_OUT"; rm -rf "$INC_DIR"
+echo "== chaos: incident recorder (worker-kill flight dump) =="
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --workers 2 --worker-kill 6 --incident-dir "$INC_DIR" --out "$INC_OUT"
+DUMP=$(find "$INC_DIR" -name crash_report.json | head -1)
+if [ -z "$DUMP" ]; then
+  echo "chaos: worker death left no incident dump under $INC_DIR" >&2
+  exit 1
+fi
+echo "chaos: incident dump at $DUMP"
+python -m tpu_trainer.tools.analyze "$INC_OUT" \
+  --compare "$INC_OUT" --reject-tol 0.0 --queue-wait-tol 60.0
 
 echo "chaos: full matrix clean ($OUT)"

@@ -359,5 +359,8 @@ class TestReplicaKillChaosLane:
             ["--smoke", "--workload", "shared_prefix", "--replicas", "3",
              "--ab", "--replica-kill", "6", "--out", out]) == 0
         from tpu_trainer.tools.analyze import main as analyze_main
+        # Chaos tolerance: the kill drill's failover stall legitimately
+        # inflates queue waits past the 1s default ceiling.
         assert analyze_main(
-            [out, "--compare", out, "--reject-tol", "0.0"]) == 0
+            [out, "--compare", out, "--reject-tol", "0.0",
+             "--queue-wait-tol", "60.0"]) == 0

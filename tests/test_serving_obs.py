@@ -1,0 +1,477 @@
+"""Serving observability tests (ISSUE 17): span tracing, the serve-loop
+ledger, the incident flight recorder, and the analyze gates over them.
+
+Tier-1 (not in conftest's _SLOW_MODULES), all on CPU in deterministic
+``time_mode="steps"`` where an engine is involved. The load-bearing
+assertions:
+
+- span conservation: every accepted rid closes with exactly ONE
+  terminal event — under normal drain, cancel, deadline expiry, forced
+  preemption, in-process failover AND a real SIGKILL'd worker process;
+- span events are plain JSON dicts that cross the RPC wire losslessly,
+  and a cross-process fleet's worker-side events merge into the
+  front-end's single per-rid timeline (one clock domain, no skew);
+- the ServingLedger's category fractions sum to <= 1.0 on a fake clock
+  and attribute exactly what was tracked;
+- tracing is FREE in token space: the same trace with ``trace=False``
+  yields bit-identical streams (and an empty tracer);
+- ``request_metrics`` surfaces a ``queue_wait`` series (admission wait
+  per request) alongside ttft/tpot;
+- front-end load sums (``queue_depth``/``outstanding_tokens``) count
+  draining-but-alive replicas — a draining replica still runs its
+  admitted work (the frontend.py load-sum pin);
+- an incident (replica kill / worker death / injected drain failure)
+  dumps the span-event ring through utils/flight_recorder.py as an
+  atomic ``crash_report.json``;
+- analyze's ``span_conservation`` categorical gate FAILs on an
+  injected dropped-terminal event and its ``serve_queue_wait_p99``
+  absolute gate FAILs past the tolerance.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT
+from tpu_trainer.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+    WorkerSupervisor,
+)
+from tpu_trainer.serving.engine import request_metrics
+from tpu_trainer.serving.tracing import (
+    ServingLedger,
+    SpanTracer,
+    phase_breakdown,
+    span_record,
+)
+from tpu_trainer.tools import analyze
+from tpu_trainer.utils import faults
+from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+# Same tiny model as test_frontend/test_worker ON PURPOSE: the jit
+# cache is warm by the time this module runs in a shared process.
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+                dtype="float32", param_dtype="float32")
+BLOCK = 8
+ENGINE_KW = dict(block_size=BLOCK, attention="reference",
+                 prefix_cache=True, max_batch=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def sup(params):
+    s = WorkerSupervisor(params, CFG,
+                         engine_kwargs=dict(ENGINE_KW, trace=True))
+    s.prewarm(2)
+    yield s
+    s.close()
+
+
+def _requests(n=6, max_new=6, prefix_len=2 * BLOCK, seed=0,
+              temperature=0.0):
+    """Shared-prefix trace; a fresh RandomState per call so two calls
+    build byte-identical traces (the bit-identity A/B depends on it)."""
+    rs = np.random.RandomState(seed)
+    prefix = rs.randint(1, CFG.vocab_size, size=prefix_len).tolist()
+    reqs = []
+    for i in range(n):
+        tail = rs.randint(1, CFG.vocab_size,
+                          size=4 + (i % 3) * 4).tolist()
+        reqs.append(Request(
+            rid=i, prompt=prefix + tail, max_new_tokens=max_new,
+            sampling=SamplingParams(temperature=temperature, seed=100 + i),
+            arrival_time=0.0))
+    return reqs
+
+
+def _events_of(tracer, rid):
+    return [e["event"] for e in tracer.events(rid)]
+
+
+# --- SpanTracer (pure python) ----------------------------------------------
+
+class TestSpanTracer:
+    def test_open_rid_breaks_conservation_until_terminal(self):
+        tr = SpanTracer()
+        tr.emit(0, "submitted", 0.0)
+        tr.emit(0, "admitted", 1.0, queue_wait=1.0)
+        assert tr.conservation()["ok"] is False
+        assert tr.conservation()["open"] == [0]
+        tr.emit(0, "finished", 2.0)
+        assert tr.conservation()["ok"] is True
+
+    def test_double_terminal_is_flagged(self):
+        tr = SpanTracer()
+        tr.emit(1, "admitted", 0.0)
+        tr.emit(1, "finished", 1.0)
+        tr.emit(1, "cancelled", 2.0)
+        cons = tr.conservation()
+        assert cons["ok"] is False and cons["multi_terminal"] == [1]
+
+    def test_rejected_and_exported_rids_owe_no_terminal(self):
+        tr = SpanTracer()
+        tr.emit(0, "submitted", 0.0)
+        tr.emit(0, "rejected", 0.0, reason="queue_full")
+        tr.emit(1, "admitted", 0.0)
+        tr.emit(1, "exported", 1.0)       # handed to another replica
+        assert tr.conservation()["ok"] is True
+
+    def test_disabled_tracer_emits_nothing(self):
+        tr = SpanTracer(enabled=False)
+        tr.emit(0, "submitted", 0.0)
+        assert len(tr) == 0 and tr.drain() == []
+        assert tr.conservation()["ok"] is True
+
+    def test_drain_is_the_wire_delta_and_json_lossless(self):
+        tr = SpanTracer()
+        tr.emit(0, "submitted", 0.5)
+        tr.emit(0, "routed", 0.5, replica=2, policy="affinity")
+        delta = tr.drain()
+        assert tr.drain() == []           # drained: nothing pending
+        # The wire is JSON — events must survive a round trip exactly.
+        wired = json.loads(json.dumps(delta))
+        assert wired == delta
+        other = SpanTracer()
+        other.ingest(wired)
+        assert other.events(0) == tr.events(0)
+        # Non-pending ingest must NOT echo foreign events back out.
+        assert other.drain() == []
+
+    def test_phase_breakdown_derives_queue_prefill_decode(self):
+        evs = [
+            {"rid": 0, "event": "submitted", "t": 1.0},
+            {"rid": 0, "event": "admitted", "t": 3.0, "queue_wait": 2.0},
+            {"rid": 0, "event": "first_token", "t": 7.0},
+            {"rid": 0, "event": "finished", "t": 12.0},
+        ]
+        phases = phase_breakdown(evs)
+        assert phases["queue_wait"] == pytest.approx(2.0)
+        assert phases["prefill"] == pytest.approx(4.0)
+        assert phases["decode"] == pytest.approx(5.0)
+        assert phases["total"] == pytest.approx(11.0)
+        rec = span_record(0, evs, lane="x")
+        assert rec["kind"] == "span"
+        assert rec["schema_version"] == SCHEMA_VERSION
+        assert rec["queue_wait_s"] == pytest.approx(2.0)
+        assert rec["n_events"] == 4 and rec["lane"] == "x"
+
+
+# --- ServingLedger on a fake clock -----------------------------------------
+
+class TestServingLedger:
+    def test_fractions_attribute_tracked_time_and_sum_below_one(self):
+        t = [0.0]
+        led = ServingLedger(clock=lambda: t[0])
+
+        def spend(cat, dt):
+            with led.track(cat):
+                t[0] += dt
+
+        spend("dispatch", 6.0)
+        spend("host_sched", 2.0)
+        spend("rpc_wait", 1.0)
+        t[0] += 1.0                        # untracked gap
+        rec = led.record({"queue_depth": 3}, final=True)
+        assert rec["kind"] == "serve_ts" and rec["final"] is True
+        assert rec["total_seconds"] == pytest.approx(10.0)
+        assert rec["dispatch_frac"] == pytest.approx(0.6)
+        assert rec["host_sched_frac"] == pytest.approx(0.2)
+        assert rec["rpc_wait_frac"] == pytest.approx(0.1)
+        assert rec["untracked_frac"] == pytest.approx(0.1)
+        fracs = sum(rec[f"{c}_frac"] for c in ServingLedger.CATEGORIES
+                    if f"{c}_frac" in rec)
+        assert fracs <= 1.0 + 1e-9
+        assert rec["queue_depth"] == 3     # gauges merge verbatim
+
+
+# --- engine-level tracing --------------------------------------------------
+
+class TestEngineObservability:
+    def test_drained_run_conserves_and_surfaces_queue_wait(self, params):
+        eng = ServingEngine(params, CFG, **ENGINE_KW)
+        fin = eng.run(_requests(), time_mode="steps")
+        assert len(fin) == 6
+        cons = eng.tracer.conservation()
+        assert cons["ok"], cons
+        for r in fin:
+            names = _events_of(eng.tracer, r.rid)
+            assert "admitted" in names and "first_token" in names
+            assert names.count("finished") == 1
+        lat = request_metrics(fin)
+        assert len(lat["queue_wait"]) == len(fin)
+        assert all(q >= 0.0 for q in lat["queue_wait"])
+
+    def test_serve_ts_samples_with_bounded_fractions(self, params):
+        eng = ServingEngine(params, CFG, ts_interval=2, **ENGINE_KW)
+        eng.run(_requests(), time_mode="steps")
+        assert eng.serve_ts                      # periodic + final samples
+        assert eng.serve_ts[-1].get("final") is True
+        for rec in eng.serve_ts:
+            fracs = sum(rec.get(f"{c}_frac", 0.0)
+                        for c in ServingLedger.CATEGORIES)
+            assert 0.0 <= fracs <= 1.0 + 1e-9
+            assert rec["kind"] == "serve_ts"
+            assert rec["schema_version"] == SCHEMA_VERSION
+
+    def test_tracing_off_is_bit_identical_and_silent(self, params):
+        on = ServingEngine(params, CFG, trace=True, **ENGINE_KW)
+        fin_on = on.run(_requests(temperature=0.9), time_mode="steps")
+        off = ServingEngine(params, CFG, trace=False, **ENGINE_KW)
+        fin_off = off.run(_requests(temperature=0.9), time_mode="steps")
+        assert ([r.generated for r in fin_on]
+                == [r.generated for r in fin_off])
+        assert len(on.tracer) > 0
+        assert len(off.tracer) == 0    # span tracing really was off
+
+    def test_forced_preemption_keeps_spans_conserved(self, params):
+        # Same tight pool as test_serving's preemption tests: 4 usable
+        # blocks across 2 slots forces a mid-decode preempt + resume.
+        rs = np.random.RandomState(1)
+        reqs = [Request(rid=i,
+                        prompt=rs.randint(1, CFG.vocab_size,
+                                          size=p).tolist(),
+                        max_new_tokens=8,
+                        sampling=SamplingParams(temperature=0.0,
+                                                seed=100 + i))
+                for i, p in enumerate([5, 11, 16, 3])]
+        eng = ServingEngine(params, CFG, max_batch=2, block_size=8,
+                            num_blocks=5, attention="reference")
+        fin = eng.run(reqs, time_mode="steps")
+        assert eng.scheduler.n_preemptions > 0   # the tight pool preempted
+        assert eng.tracer.conservation()["ok"]
+        preempted = [r for r in fin if r.preemptions > 0]
+        assert preempted
+        names = _events_of(eng.tracer, preempted[0].rid)
+        assert "preempted" in names
+        # Re-admission after preemption is a resume, not a second open.
+        assert names.count("finished") == 1
+
+
+# --- front-end: merged fleet timeline, pins, incidents ---------------------
+
+class TestFrontendObservability:
+    def _fe(self, params, **kw):
+        kw.setdefault("replicas", 2)
+        kw.setdefault("routing", "affinity")
+        kw.setdefault("time_mode", "steps")
+        for k, v in ENGINE_KW.items():
+            kw.setdefault(k, v)
+        return ServingFrontend(params, CFG, **kw)
+
+    def test_replica_events_merge_into_one_timeline(self, params):
+        fe = self._fe(params)
+        fin = fe.run(_requests())
+        s = fe.summary()
+        assert s["span_conservation_ok"] is True
+        assert s["span_events"] == len(fe.tracer)
+        rid = fin[0].rid
+        names = _events_of(fe.tracer, rid)
+        # Front-door events (submitted/routed) and replica-engine events
+        # (admitted/first_token/finished) share ONE per-rid timeline.
+        for ev in ("submitted", "routed", "admitted", "first_token",
+                   "finished"):
+            assert ev in names, (ev, names)
+        assert names.index("submitted") < names.index("admitted")
+        routed = [e for e in fe.tracer.events(rid)
+                  if e["event"] == "routed"]
+        assert routed[0]["replica"] in (0, 1)
+
+    def test_load_sums_count_draining_replicas(self, params):
+        # The frontend.py load-sum pin: shrink marks a replica draining
+        # but it keeps RUNNING its admitted work, so fleet load sums
+        # must still include it until it reaps.
+        fe = self._fe(params, routing="least_loaded")
+        for r in _requests(n=6, max_new=16):
+            assert fe.submit(r).accepted
+        fe.step()                      # work admitted on both replicas
+        assert all(h.engine.outstanding_tokens > 0 for h in fe._replicas)
+        fe.shrink(1)
+        victim = fe._replicas[-1]
+        assert victim.draining and victim.alive
+        assert victim.engine.outstanding_tokens > 0   # still running
+        s = fe.summary()
+        want = sum(h.engine.outstanding_tokens
+                   for h in fe._replicas if h.alive)
+        assert s["outstanding_tokens"] == want
+        assert (s["outstanding_tokens"]
+                > want - victim.engine.outstanding_tokens)
+        fe.drain()
+
+    def test_cancel_and_deadline_close_spans(self, params):
+        fe = self._fe(params)
+        reqs = _requests(n=4, max_new=12)
+        reqs[3].deadline = 2.0          # steps mode: expires at iter 2
+        for r in reqs:
+            assert fe.submit(r).accepted
+        fe.step()
+        assert fe.cancel(reqs[0].rid)
+        fe.drain()
+        s = fe.summary()
+        assert s["span_conservation_ok"] is True, fe.tracer.conservation()
+        assert _events_of(fe.tracer, reqs[0].rid)[-1] == "cancelled"
+        assert "deadline_exceeded" in _events_of(fe.tracer, reqs[3].rid)
+
+    def test_replica_kill_dumps_incident_and_conserves(
+            self, params, tmp_path, monkeypatch):
+        inc = str(tmp_path / "incidents")
+        fe = self._fe(params, incident_dir=inc)
+        victim = fe._rendezvous(
+            fe._affinity_key(_requests()[0].prompt), fe._live()).rid
+        monkeypatch.setenv("TPU_TRAINER_FAULT_REPLICA", str(victim))
+        with faults.plan("replica_kill@3"):
+            fin = fe.run(_requests())
+        s = fe.summary()
+        assert s["finished"] == s["accepted"] == len(fin)
+        assert s["failover_events"] == 1
+        assert s["span_conservation_ok"] is True, fe.tracer.conservation()
+        assert s["incidents"] == 1
+        rec = fe.incidents[0]
+        assert rec["kind"] == "incident"
+        assert rec["reason"] == "replica_kill"
+        assert rec["replica"] == victim
+        dump = os.path.join(rec["dump_dir"], "crash_report.json")
+        assert os.path.exists(dump)
+        with open(dump) as f:
+            report = json.load(f)
+        assert report["reason"] == "replica_kill"
+        # The ring held the victim's span events up to the kill.
+        assert any(r.get("event") for r in report["records"])
+        # A failed-over rid carries the handoff markers, one terminal.
+        moved = [rid for rid in fe.tracer.rids()
+                 if "failed_over" in _events_of(fe.tracer, rid)]
+        assert moved
+        names = _events_of(fe.tracer, moved[0])
+        assert "exported" in names or "failed_over" in names
+        assert sum(names.count(t) for t in
+                   ("finished", "cancelled", "deadline_exceeded",
+                    "failed")) == 1
+
+
+# --- cross-process: the RPC wire and a real SIGKILL ------------------------
+
+class TestWorkerTraceWire:
+    def _fe(self, params, sup, **kw):
+        kw.setdefault("replicas", 2)
+        kw.setdefault("routing", "affinity")
+        kw.setdefault("time_mode", "steps")
+        return ServingFrontend(params, CFG, replica_factory=sup, **kw)
+
+    def test_worker_spans_merge_losslessly(self, params, sup):
+        fe = self._fe(params, sup)
+        fin = fe.run(_requests())
+        s = fe.summary()
+        assert s["transport"] == "rpc"
+        assert s["span_conservation_ok"] is True, fe.tracer.conservation()
+        rid = fin[0].rid
+        names = _events_of(fe.tracer, rid)
+        # submitted/routed were emitted front-end-side; admitted,
+        # first_token and finished crossed the wire from the worker
+        # process — all merged into one timeline.
+        for ev in ("submitted", "routed", "admitted", "first_token",
+                   "finished"):
+            assert ev in names, (ev, names)
+        # Worker timestamps are already in the front-end clock domain
+        # (steps mode: integral iteration numbers, monotone per rid).
+        ts = [e["t"] for e in fe.tracer.events(rid)]
+        assert ts == sorted(ts)
+        assert all(float(t) == float(int(t)) for t in ts)
+        # And the merged events are still pure JSON.
+        evs = fe.tracer.events(rid)
+        assert json.loads(json.dumps(evs)) == evs
+        sup.reset()
+
+    def test_sigkill_dumps_incident_and_conserves(
+            self, params, sup, tmp_path, monkeypatch):
+        inc = str(tmp_path / "incidents")
+        fe = self._fe(params, sup, incident_dir=inc)
+        victim = fe._rendezvous(
+            fe._affinity_key(_requests()[0].prompt), fe._live()).rid
+        monkeypatch.setenv("TPU_TRAINER_FAULT_REPLICA", str(victim))
+        with faults.plan("worker_kill@3"):
+            fin = fe.run(_requests())
+        s = fe.summary()
+        assert s["worker_deaths"] == 1
+        assert s["finished"] == s["accepted"] == len(fin)
+        assert s["span_conservation_ok"] is True, fe.tracer.conservation()
+        assert [r["reason"] for r in fe.incidents] == ["worker_death"]
+        dump = os.path.join(fe.incidents[0]["dump_dir"],
+                            "crash_report.json")
+        assert os.path.exists(dump)
+        with open(dump) as f:
+            assert json.load(f)["reason"] == "worker_death"
+        sup.reset()
+
+
+# --- analyze: the observability gates --------------------------------------
+
+def _write(tmp_path, name, records):
+    path = tmp_path / name
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def _span(rid, *, terminal="finished", queue_wait=0.01):
+    evs = [
+        {"rid": rid, "event": "submitted", "t": 0.0},
+        {"rid": rid, "event": "admitted", "t": queue_wait,
+         "queue_wait": queue_wait},
+        {"rid": rid, "event": "first_token", "t": queue_wait + 0.02},
+    ]
+    if terminal:
+        evs.append({"rid": rid, "event": terminal,
+                    "t": queue_wait + 0.05})
+    return span_record(rid, evs, lane="serve")
+
+
+class TestAnalyzeObservabilityGates:
+    def test_span_conservation_gate_fails_on_dropped_terminal(
+            self, tmp_path):
+        good = [_span(0), _span(1)]
+        base = analyze.summarize(analyze.load_records(
+            _write(tmp_path, "base.jsonl", good)))
+        assert base["spans"]["conservation_ok"] is True
+        # Inject the dropped-terminal: rid 1 opened but never closed.
+        bad = [_span(0), _span(1, terminal=None)]
+        new = analyze.summarize(analyze.load_records(
+            _write(tmp_path, "new.jsonl", bad)))
+        assert new["spans"]["conservation_ok"] is False
+        assert new["spans"]["open"] == [1]
+        verdicts = {v["metric"]: v for v in analyze.compare(base, new)}
+        assert verdicts["span_conservation"]["verdict"] == "FAIL"
+        assert verdicts["span_conservation"]["absolute"] is True
+        # The same categorical gate passes the clean run.
+        ok = {v["metric"]: v for v in analyze.compare(base, base)}
+        assert ok["span_conservation"]["verdict"] == "PASS"
+
+    def test_queue_wait_gate_is_absolute(self, tmp_path):
+        base = analyze.summarize(analyze.load_records(
+            _write(tmp_path, "b.jsonl", [_span(0, queue_wait=0.01)])))
+        slow = analyze.summarize(analyze.load_records(
+            _write(tmp_path, "n.jsonl", [_span(0, queue_wait=5.0)])))
+        verdicts = {v["metric"]: v
+                    for v in analyze.compare(base, slow,
+                                             queue_wait_tol=1.0)}
+        v = verdicts["serve_queue_wait_p99"]
+        assert v["verdict"] == "FAIL" and v["absolute"] is True
+        # Absolute means the BASELINE doesn't excuse it: base vs base
+        # passes, and a loose tolerance passes the slow run too.
+        ok = {x["metric"]: x
+              for x in analyze.compare(base, slow, queue_wait_tol=10.0)}
+        assert ok["serve_queue_wait_p99"]["verdict"] == "PASS"

@@ -610,4 +610,4 @@ class TestWorkerKillChaosLane:
         from tpu_trainer.tools.analyze import main as analyze_main
         assert analyze_main(
             [out, "--compare", out, "--reject-tol", "0.0",
-             "--rpc-overhead-tol", "5.0"]) == 0
+             "--rpc-overhead-tol", "5.0", "--queue-wait-tol", "60.0"]) == 0

@@ -63,6 +63,7 @@ from tpu_trainer.serving.spec import (
     _verify_step,
     draft_from_target,
 )
+from tpu_trainer.serving.tracing import ServingLedger, SpanTracer
 
 
 def _bucket_pow2(n: int, lo: int = 8) -> int:
@@ -98,6 +99,9 @@ class ServingEngine:
         draft_config: Optional[GPTConfig] = None,
         spec_proposer=None,
         clock=time.perf_counter,
+        trace: bool = True,
+        ts_interval: int = 32,
+        metric_logger=None,
     ):
         if spec not in ("off", "ngram", "draft"):
             raise ValueError(f"spec={spec!r} (off | ngram | draft)")
@@ -152,6 +156,17 @@ class ServingEngine:
             spec_reserve_tokens=(
                 spec_k + 1 if self.spec_decoder is not None else 0),
         )
+        # Observability (serving/tracing.py): per-rid span timelines in
+        # this engine's clock domain, and wall-clock attribution for the
+        # run loop. Both host-side only — they can never perturb the
+        # jitted path, so token streams are bit-identical trace on/off.
+        self.tracer = SpanTracer(enabled=trace)
+        self.scheduler.tracer = self.tracer
+        self.scheduler.now_fn = self._now
+        self.ledger = ServingLedger()
+        self.ts_interval = int(ts_interval)
+        self.metric_logger = metric_logger
+        self.serve_ts: List[dict] = []
         self.device_cache = init_paged_cache(self.config, max_batch)
         self._model = GPT(self.config)
         self._step_jit = _jitted_engine_step(self.config)
@@ -191,6 +206,9 @@ class ServingEngine:
         self._deadline_margins = []
         if self.spec_decoder is not None:
             self.spec_decoder.reset_stats()
+        self.tracer.reset()
+        self.ledger.reset()
+        self.serve_ts = []
         for k in self.stats:
             self.stats[k] = 0.0 if isinstance(self.stats[k], float) else 0
 
@@ -202,8 +220,9 @@ class ServingEngine:
         the deadline sweep retired at the boundary (their blocks are
         already back in the pool)."""
         self._iters += 1
-        terminal = self._expire_deadlines()
-        kind, reqs = self.scheduler.schedule()
+        with self.ledger.track("host_sched"):
+            terminal = self._expire_deadlines()
+            kind, reqs = self.scheduler.schedule()
         if kind == "idle":
             self.stats["idle_iters"] += 1
             return terminal
@@ -318,16 +337,17 @@ class ServingEngine:
             if r.sampling.top_k > self._k_cap:
                 self._k_cap = r.sampling.top_k
 
-        self.device_cache, tokens = self._step_jit(
-            self.params, self.device_cache,
-            jnp.asarray(tables), jnp.asarray(lengths),
-            jnp.asarray(offsets), jnp.asarray(ids),
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-            jnp.asarray(keys),
-            jnp.asarray(steps), k_cap=self._k_cap, prefill=prefill,
-            hist_blocks=hist_blocks,
-        )
-        tokens = np.asarray(tokens)
+        with self.ledger.track("dispatch"):
+            self.device_cache, tokens = self._step_jit(
+                self.params, self.device_cache,
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(offsets), jnp.asarray(ids),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                jnp.asarray(keys),
+                jnp.asarray(steps), k_cap=self._k_cap, prefill=prefill,
+                hist_blocks=hist_blocks,
+            )
+            tokens = np.asarray(tokens)   # host read = dispatch sync
 
         now = self._now()
         finished: List[Request] = []
@@ -335,6 +355,9 @@ class ServingEngine:
             if prefill:
                 r.prefill_cursor += r.prefill_chunk
                 cs.lengths[r.slot] = r.prefill_cursor
+                self.tracer.emit(r.rid, "prefill_chunk", now,
+                                 tokens=r.prefill_chunk,
+                                 cursor=r.prefill_cursor)
                 if self.prefix_cache:
                     self._register_prefix_blocks(r)
                 if r.prefilling():
@@ -350,6 +373,7 @@ class ServingEngine:
             cs.lengths[r.slot] = r.context_len() - 1
             if r.first_token_at is None:
                 r.first_token_at = now
+                self.tracer.emit(r.rid, "first_token", now)
             if (r.eos_id is not None and tok == r.eos_id) or (
                 len(r.generated) >= r.max_new_tokens
             ):
@@ -426,16 +450,17 @@ class ServingEngine:
         hist_blocks = min(
             _bucket_pow2(cs.blocks_for(max_off), lo=1), cs.max_blocks)
 
-        self.device_cache, emitted, n_acc = self._verify_jit(
-            self.params, self.device_cache,
-            jnp.asarray(tables), jnp.asarray(lengths),
-            jnp.asarray(offsets), jnp.asarray(ids), jnp.asarray(dlens),
-            jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
-            jnp.asarray(keys), jnp.asarray(steps),
-            k_cap=self._k_cap, hist_blocks=hist_blocks,
-        )
-        emitted = np.asarray(emitted)
-        n_acc = np.asarray(n_acc)
+        with self.ledger.track("dispatch"):
+            self.device_cache, emitted, n_acc = self._verify_jit(
+                self.params, self.device_cache,
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(offsets), jnp.asarray(ids), jnp.asarray(dlens),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                jnp.asarray(keys), jnp.asarray(steps),
+                k_cap=self._k_cap, hist_blocks=hist_blocks,
+            )
+            emitted = np.asarray(emitted)
+            n_acc = np.asarray(n_acc)
 
         now = self._now()
         finished: List[Request] = []
@@ -443,6 +468,8 @@ class ServingEngine:
             m = int(dlens[r.slot])
             j = int(n_acc[r.slot])
             sd.observe(r, m, j)
+            if m > 0:
+                self.tracer.emit(r.rid, "spec_window", now, k=m, accepted=j)
             self.stats["spec_steps"] += 1
             self.stats["spec_drafted"] += m
             self.stats["spec_accepted"] += j
@@ -454,6 +481,7 @@ class ServingEngine:
                 self.stats["generated_tokens"] += 1
                 if r.first_token_at is None:
                     r.first_token_at = now
+                    self.tracer.emit(r.rid, "first_token", now)
                 if (r.eos_id is not None and tok == r.eos_id) or (
                     len(r.generated) >= r.max_new_tokens
                 ):
@@ -529,6 +557,7 @@ class ServingEngine:
         *,
         time_mode: str = "wall",
         max_iters: int = 10_000_000,
+        profiler=None,
     ) -> List[Request]:
         """Replay an open-loop trace: each request joins the waiting queue
         when the clock passes its ``arrival_time``. ``time_mode="wall"``
@@ -537,7 +566,14 @@ class ServingEngine:
         Returns the finished requests in input order; requests that
         ended cancelled or past their deadline are dropped from the
         return (their terminal state lives on the Request objects the
-        caller already holds, and in ``summary()``)."""
+        caller already holds, and in ``summary()``).
+
+        ``profiler`` (utils.profiling.WindowedTrace or anything with a
+        ``step(i) -> context`` method) wraps each engine iteration in a
+        ``jax.profiler.StepTraceAnnotation`` while its window is open —
+        the serve_bench ``--profile-trace`` hook. Every ``ts_interval``
+        iterations the run appends a ``kind:"serve_ts"`` sample (ledger
+        fractions + as-of-now gauges) to ``self.serve_ts``."""
         if time_mode not in ("wall", "steps"):
             raise ValueError(f"time_mode={time_mode!r}")
         pending = sorted(requests, key=lambda r: (r.arrival_time, r.rid))
@@ -552,19 +588,55 @@ class ServingEngine:
             while pending and pending[0].arrival_time <= now:
                 self.scheduler.add(pending.pop(0))
             if not self.scheduler.has_work():
-                if time_mode == "wall":
-                    time.sleep(
-                        min(1e-3, max(0.0, pending[0].arrival_time - now))
-                    )
-                else:
-                    self._iters += 1   # idle tick advances the step clock
+                with self.ledger.track("idle"):
+                    if time_mode == "wall":
+                        time.sleep(
+                            min(1e-3,
+                                max(0.0, pending[0].arrival_time - now))
+                        )
+                    else:
+                        self._iters += 1  # idle tick advances the clock
                 continue
-            done.extend(self.step())
+            if profiler is None:
+                done.extend(self.step())
+            else:
+                with profiler.step(self._iters):
+                    done.extend(self.step())
+            if self.ts_interval and self._iters % self.ts_interval == 0:
+                self._emit_ts()
             if self._iters >= max_iters:
                 raise RuntimeError(f"engine did not drain in {max_iters} iters")
         self.wall_elapsed = self.clock() - t_start
+        self._emit_ts(final=True)
         by_rid = {r.rid: r for r in done if r.status == "finished"}
         return [by_rid[r.rid] for r in requests if r.rid in by_rid]
+
+    def _emit_ts(self, final: bool = False) -> dict:
+        """One ``kind:"serve_ts"`` time-series sample: the ledger's
+        wall-clock attribution so far plus as-of-now load gauges. Routed
+        through ``metric_logger`` (the MetricLogger JSONL/TB/wandb sinks)
+        when one is attached; always kept on ``self.serve_ts``."""
+        s = self.stats
+        gauges = {
+            "t": round(self._now(), 6),
+            "iter": int(self._iters),
+            "queue_depth": self.queue_depth,
+            "running": len(self.scheduler.running),
+            "outstanding_tokens": self.outstanding_tokens,
+            "occupancy": round(float(self.cache_state.pool.occupancy), 4),
+            "generated_tokens": int(s["generated_tokens"]),
+            "prefix_hit_rate": round(
+                self.scheduler.prefix_hit_tokens
+                / max(1, self.scheduler.prompt_tokens), 4),
+        }
+        if self.spec_decoder is not None:
+            gauges["spec_accept_rate"] = round(
+                s["spec_accepted"] / max(1, int(s["spec_drafted"])), 4)
+        rec = self.ledger.record(gauges, final=final)
+        self.serve_ts.append(rec)
+        if self.metric_logger is not None:
+            self.metric_logger.log_record(rec)
+        return rec
 
     def summary(self) -> Dict[str, float]:
         s = dict(self.stats)
@@ -716,9 +788,17 @@ def request_metrics(reqs: Sequence[Request]) -> Dict[str, List[float]]:
     stream for the whole prompt, which a per-request MEAN averages away —
     the p99 of the gaps is where that tail lives (and what chunked
     prefill is for). Falls back to the mean-gap estimate for requests
-    recorded without per-token timestamps."""
-    ttft, tpot = [], []
+    recorded without per-token timestamps.
+
+    ``queue_wait`` = first admission minus arrival (one sample per
+    admitted request, preemption re-admissions excluded) — the phase
+    TTFT hides: a request can clear admission instantly and still pay a
+    long prefill, or sit queued behind a full pool. Comes from the span
+    layer's ``admitted_at`` stamp, so it survives the RPC wire."""
+    ttft, tpot, queue_wait = [], [], []
     for r in reqs:
+        if r.admitted_at is not None:
+            queue_wait.append(max(0.0, r.admitted_at - r.arrival_time))
         if r.first_token_at is None:
             continue
         ttft.append(r.first_token_at - r.arrival_time)
@@ -730,7 +810,7 @@ def request_metrics(reqs: Sequence[Request]) -> Dict[str, List[float]]:
             n_rest = len(r.generated) - 1
             if n_rest > 0 and r.finished_at is not None:
                 tpot.append((r.finished_at - r.first_token_at) / n_rest)
-    return {"ttft": ttft, "tpot": tpot}
+    return {"ttft": ttft, "tpot": tpot, "queue_wait": queue_wait}
 
 
 def _main() -> int:

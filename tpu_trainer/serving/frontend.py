@@ -102,7 +102,10 @@ from tpu_trainer.serving.engine import ServingEngine
 from tpu_trainer.serving.paged_cache import chained_block_digests
 from tpu_trainer.serving.remote import ReplicaDied
 from tpu_trainer.serving.scheduler import Request
+from tpu_trainer.serving.tracing import ServingLedger, SpanTracer
 from tpu_trainer.utils import faults
+from tpu_trainer.utils.flight_recorder import FlightRecorder
+from tpu_trainer.utils.logging import SCHEMA_VERSION
 from tpu_trainer.utils.preemption import consume_capacity, read_capacity
 
 ROUTINGS = ("affinity", "random", "least_loaded")
@@ -136,7 +139,12 @@ class LocalReplica:
     def __init__(self, engine: ServingEngine):
         self.engine = engine
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request, trace: Optional[List[dict]] = None) -> None:
+        if trace:
+            # Same contract as RemoteReplica: front-door span context
+            # merges into the engine's tracer (non-pending — never
+            # echoed back to the front-end that already holds it).
+            self.engine.tracer.ingest(trace)
         self.engine.scheduler.add(req)
 
     def step(self) -> List[Request]:
@@ -161,6 +169,12 @@ class LocalReplica:
 
     def export_requests(self, *, waiting_only: bool = False) -> List[Request]:
         return self.engine.export_requests(waiting_only=waiting_only)
+
+    def drain_span_events(self) -> List[dict]:
+        """Span events the engine emitted since the last drain — the
+        same delta surface ``RemoteReplica`` fills from step replies, so
+        the front-end merges both transports identically."""
+        return self.engine.tracer.drain()
 
     def release(self) -> None:
         self.engine.device_cache = None   # drop the KV pools
@@ -222,6 +236,11 @@ class ServingFrontend:
         clock=time.perf_counter,
         seed: int = 0,
         replica_factory=None,
+        trace: bool = True,
+        ts_interval: int = 32,
+        incident_dir: Optional[str] = None,
+        ring_capacity: int = 256,
+        metric_logger=None,
         **engine_kwargs,
     ):
         if replicas < 1:
@@ -255,7 +274,25 @@ class ServingFrontend:
         self._supervisor = (replica_factory
                             if hasattr(replica_factory, "poll_deaths")
                             else None)
+        # Replica engines inherit the tracing switch so local emission
+        # and front-end merging toggle together (a bare bool, so the
+        # RPC worker spec serializes it too).
+        engine_kwargs.setdefault("trace", trace)
         self._engine_kwargs = engine_kwargs
+        # Fleet observability: one merged tracer (front-door events plus
+        # replica deltas drained after each step), per-replica flight-
+        # recorder rings fed off every event, a serve-loop ledger, and
+        # periodic serve_ts samples. All host-side — the jitted path
+        # and the sampled tokens cannot see any of it.
+        self.tracer = SpanTracer(on_event=self._ring_observe, enabled=trace)
+        self.ledger = ServingLedger()
+        self.ts_interval = int(ts_interval)
+        self.incident_dir = incident_dir
+        self.ring_capacity = int(ring_capacity)
+        self.metric_logger = metric_logger
+        self.serve_ts: List[dict] = []
+        self.incidents: List[dict] = []
+        self._rings: Dict[int, FlightRecorder] = {}
         self._rs = np.random.RandomState(seed)
         self._replicas: List[_Replica] = []
         self._next_rid = 0
@@ -323,6 +360,103 @@ class ServingFrontend:
         if self._t0 is None:
             self._t0 = self.clock()
         return self.clock() - self._t0
+
+    # -- observability -----------------------------------------------------
+
+    def _emit(self, rid, event: str, **attrs) -> None:
+        self.tracer.emit(rid, event, self._now(), **attrs)
+
+    def _ring_observe(self, ev: dict) -> None:
+        """Every merged span event lands in its replica's ring (capacity
+        ``ring_capacity``, oldest evicted) — the raw material an
+        incident dump freezes. Front-door events (submit/route, no
+        replica yet) share the fleet ring keyed -1."""
+        key = int(ev.get("replica", -1))
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = self._rings[key] = FlightRecorder(
+                capacity=self.ring_capacity,
+                snapshot=self._incident_snapshot)
+        ring.observe(ev)
+
+    def _drain_spans(self, h: _Replica) -> None:
+        """Merge the replica's span-event delta into the fleet timeline,
+        stamped with the replica id. Worker clocks already run in the
+        front-end domain (worker.py pins ``_t0 = 0``), so timestamps
+        merge without skew correction."""
+        if not self.tracer.enabled:
+            return
+        drain = getattr(h.engine, "drain_span_events", None)
+        if drain is None:
+            return
+        evs = drain()
+        for ev in evs:
+            ev.setdefault("replica", h.rid)
+        self.tracer.ingest(evs)
+
+    def _incident_snapshot(self) -> dict:
+        return {
+            "iter": self._iters,
+            "t": self._now(),
+            "replicas_live": len(self._live()),
+            "replicas_total": len(self._replicas),
+            "queue_depth": sum(
+                h.engine.queue_depth for h in self._live()),
+            "stats": {k: v for k, v in self.stats.items()
+                      if not k.startswith("imbalance_")},
+        }
+
+    def _dump_incident(self, reason: str, rid: int) -> Optional[str]:
+        """Freeze the span-event ring of the replica an incident hit
+        (plus the front-door ring for fleet-level incidents, rid=-1)
+        into an atomic ``crash_report.json`` under ``incident_dir``, and
+        count a ``kind:"incident"`` record either way. Returns the dump
+        directory, or None when ``incident_dir`` is unset."""
+        rec = {
+            "kind": "incident", "schema_version": SCHEMA_VERSION,
+            "reason": reason, "replica": rid,
+            "t": round(self._now(), 6), "iter": self._iters,
+        }
+        self.incidents.append(rec)
+        if self.metric_logger is not None:
+            self.metric_logger.log_record(rec)
+        if not self.incident_dir:
+            return None
+        ring = self._rings.get(rid)
+        if ring is None:
+            ring = self._rings[rid] = FlightRecorder(
+                capacity=self.ring_capacity,
+                snapshot=self._incident_snapshot)
+        out = os.path.join(
+            self.incident_dir, f"i{self._iters:06d}_{reason}_r{rid}")
+        ring.dump(out, reason=reason, step=self._iters)
+        rec["dump_dir"] = out
+        return out
+
+    def _emit_ts(self, final: bool = False) -> None:
+        """One fleet ``serve_ts`` sample: ledger fractions plus cheap
+        as-of-now gauges (queue/load gauges read front-end-side request
+        mirrors, so no extra RPC round-trips on remote fleets)."""
+        live = self._live()
+        gauges = {
+            "t": round(self._now(), 6),
+            "iter": self._iters,
+            "replicas_live": len(live),
+            "queue_depth": sum(h.engine.queue_depth for h in live),
+            "outstanding_tokens": sum(
+                h.engine.outstanding_tokens for h in live),
+            "in_flight": int(
+                self.stats["accepted"] - self.stats["finished"]
+                - self.stats["cancelled"]
+                - self.stats["deadline_exceeded"] - self.stats["failed"]),
+            "finished": int(self.stats["finished"]),
+            "rejected": int(self.stats["rejected"]),
+            "worker_deaths": int(self.stats["worker_deaths"]),
+        }
+        rec = self.ledger.record(gauges, final=final)
+        self.serve_ts.append(rec)
+        if self.metric_logger is not None:
+            self.metric_logger.log_record(rec)
 
     # -- routing -----------------------------------------------------------
 
@@ -394,6 +528,7 @@ class ServingFrontend:
         structured reject — the queue is never unbounded."""
         self.stats["submitted"] += 1
         now = self._now()
+        self._emit(req.rid, "submitted")
         target, routed = self._route(req)
         reason = self._admission_reason(target, now)
         if reason is not None:
@@ -404,6 +539,7 @@ class ServingFrontend:
         if reason is not None:
             self.stats["rejected"] += 1
             self.stats[f"rejected_{reason}"] += 1
+            self._emit(req.rid, "rejected", reason=reason)
             res = SubmitResult(
                 accepted=False, reason=reason,
                 queue_depth=target.engine.queue_depth,
@@ -419,7 +555,9 @@ class ServingFrontend:
         return res
 
     def _enqueue(self, h: _Replica, req: Request, routed: str) -> None:
-        h.engine.submit(req)
+        self._emit(req.rid, "routed", replica=h.rid, policy=routed)
+        ctx = self.tracer.events(req.rid) if self.tracer.enabled else None
+        h.engine.submit(req, trace=ctx)
         h.routed[routed] = h.routed.get(routed, 0) + 1
         key = f"routed_{routed}"
         self.stats[key] = self.stats.get(key, 0) + 1
@@ -449,11 +587,12 @@ class ServingFrontend:
                     ok = h.engine.cancel(rid)
                 except ReplicaDied:
                     self.stats["worker_deaths"] += 1
-                    self.kill_replica(h.rid)
+                    self.kill_replica(h.rid, reason="rpc_death")
                     retry = True
                     break
                 if ok:
                     self.stats["cancelled"] += 1
+                    self._drain_spans(h)
                     return True
             if not retry:
                 break
@@ -461,14 +600,17 @@ class ServingFrontend:
 
     # -- failover ----------------------------------------------------------
 
-    def kill_replica(self, rid: Optional[int] = None) -> int:
+    def kill_replica(self, rid: Optional[int] = None, *,
+                     reason: str = "replica_kill") -> int:
         """Mark a replica dead and fail its queued + in-flight requests
         over to the survivors (admission limits do not apply — these
         requests were already accepted; shedding them now would break
         the submit-time contract). Default victim: the env override
         ``TPU_TRAINER_FAULT_REPLICA``, else the highest-id live replica
         (mirroring ``faults.target_host``'s highest-rank convention).
-        Returns the number of requests failed over."""
+        ``reason`` tags the incident record/dump (replica_kill |
+        worker_death | rpc_death). Returns the number of requests
+        failed over."""
         live = self._live()
         if rid is None:
             raw = os.environ.get("TPU_TRAINER_FAULT_REPLICA")
@@ -480,11 +622,14 @@ class ServingFrontend:
             raise RuntimeError("cannot kill the last live replica")
         h = victims[0]
         orphans = h.engine.export_requests()
+        self._drain_spans(h)   # capture export/terminal events pre-release
         h.alive = False
         h.engine.release()
         self.stats["failover_events"] += 1
         self.stats["failed_over_requests"] += len(orphans)
+        self._dump_incident(reason, h.rid)
         for req in orphans:
+            self._emit(req.rid, "failed_over", src=h.rid, reason=reason)
             target, _ = self._route(req)
             self._enqueue(target, req, "failover")
         return len(orphans)
@@ -511,7 +656,10 @@ class ServingFrontend:
         while done < n and len(self._live(routable=True)) > 1:
             h = max(self._live(routable=True), key=lambda x: x.rid)
             h.draining = True
-            for req in h.engine.export_requests(waiting_only=True):
+            orphans = h.engine.export_requests(waiting_only=True)
+            self._drain_spans(h)
+            for req in orphans:
+                self._emit(req.rid, "failed_over", src=h.rid, reason="shrink")
                 target, _ = self._route(req)
                 self._enqueue(target, req, "failover")
             done += 1
@@ -535,6 +683,7 @@ class ServingFrontend:
     def _reap_draining(self) -> None:
         for h in self._replicas:
             if h.alive and h.draining and not h.engine.has_work():
+                self._drain_spans(h)
                 h.alive = False
                 h.engine.release()
                 self.stats["retired_replicas"] += 1
@@ -574,16 +723,23 @@ class ServingFrontend:
         for kind in ("net_delay", "net_drop", "net_garble", "net_hang"):
             if faults.fire(kind, self._iters):
                 self._arm_net_fault(kind)
-        self._settle_worker_deaths()
-        if self.capacity_file and self._iters % self.capacity_probe_every == 0:
-            self._probe_capacity()
-        self._reap_draining()
+        with self.ledger.track("host_sched"):
+            self._settle_worker_deaths()
+            if (self.capacity_file
+                    and self._iters % self.capacity_probe_every == 0):
+                self._probe_capacity()
+            self._reap_draining()
         finished: List[Request] = []
         for h in self._replicas:
             if h.alive and h.engine.has_work():
+                # An in-process replica step IS the jitted dispatch; a
+                # remote one is time blocked on the step RPC reply.
+                cat = ("dispatch" if isinstance(h.engine, LocalReplica)
+                       else "rpc_wait")
                 t_step = time.perf_counter()
                 try:
-                    out = h.engine.step()
+                    with self.ledger.track(cat):
+                        out = h.engine.step()
                 except ReplicaDied:
                     # Died — or was fenced as hung — mid-RPC: any tokens
                     # the worker generated but never reported are simply
@@ -594,8 +750,9 @@ class ServingFrontend:
                     self._stall_samples.append(
                         time.perf_counter() - t_step)
                     self.stats["worker_deaths"] += 1
-                    self.kill_replica(h.rid)
+                    self.kill_replica(h.rid, reason="rpc_death")
                     continue
+                self._drain_spans(h)
                 for r in out:
                     if r.status == "finished":
                         h.finished += 1
@@ -604,7 +761,10 @@ class ServingFrontend:
                         self.stats[r.status] += 1
                     self._observe_deadline(r)
         self.stats["finished"] += len(finished)
-        self._sample_load()
+        with self.ledger.track("host_sched"):
+            self._sample_load()
+        if self.ts_interval and self._iters % self.ts_interval == 0:
+            self._emit_ts()
         return finished
 
     def _arm_net_fault(self, kind: str) -> None:
@@ -635,7 +795,7 @@ class ServingFrontend:
         for rid in self._supervisor.poll_deaths():
             if any(h.rid == rid and h.alive for h in self._replicas):
                 self.stats["worker_deaths"] += 1
-                self.kill_replica(rid)
+                self.kill_replica(rid, reason="worker_death")
 
     def _sample_load(self) -> None:
         live = self._live()
@@ -681,16 +841,18 @@ class ServingFrontend:
         done: List[Request] = []
         while pending or self.has_work():
             now = self._now()
-            while pending and pending[0].arrival_time <= now:
-                self.submit(pending.pop(0))
+            with self.ledger.track("host_sched"):
+                while pending and pending[0].arrival_time <= now:
+                    self.submit(pending.pop(0))
             if not self.has_work():
                 if not pending:
                     break
-                if self.time_mode == "wall":
-                    time.sleep(
-                        min(1e-3, max(0.0, pending[0].arrival_time - now)))
-                else:
-                    self._iters += 1   # idle tick advances the step clock
+                with self.ledger.track("idle"):
+                    if self.time_mode == "wall":
+                        time.sleep(min(
+                            1e-3, max(0.0, pending[0].arrival_time - now)))
+                    else:
+                        self._iters += 1   # idle tick: step clock advances
                 continue
             done.extend(self.step())
             if self._iters >= max_iters:
@@ -698,6 +860,13 @@ class ServingFrontend:
                     f"front-end did not drain in {max_iters} iters")
         self._reap_draining()
         self.wall_elapsed = self.clock() - t_start
+        if self.ts_interval:
+            self._emit_ts(final=True)
+        # Span-conservation sweep: a drained run that still has open
+        # timelines dropped a terminal event somewhere — freeze the
+        # front-door ring so there is an artifact to debug from.
+        if self.tracer.enabled and not self.tracer.conservation()["ok"]:
+            self._dump_incident("drain_failure", -1)
         by_rid = {r.rid: r for r in done if r.status == "finished"}
         return [by_rid[r.rid] for r in requests if r.rid in by_rid]
 
@@ -721,9 +890,14 @@ class ServingFrontend:
             - self.stats["failed"])
         s["reject_rate"] = (
             self.stats["rejected"] / max(1, self.stats["submitted"]))
-        s["queue_depth"] = sum(h.engine.queue_depth for h in live)
+        # Load sums count every NON-DEAD replica, draining included — a
+        # draining replica still runs its admitted work, so excluding it
+        # would under-report fleet load while the all-replica token
+        # counters below still count its tokens (pinned by test).
+        loaded = [h for h in self._replicas if h.alive]
+        s["queue_depth"] = sum(h.engine.queue_depth for h in loaded)
         s["outstanding_tokens"] = sum(
-            h.engine.outstanding_tokens for h in live)
+            h.engine.outstanding_tokens for h in loaded)
         n = max(1, int(self.stats["imbalance_samples"]))
         s["load_imbalance_mean"] = self.stats["imbalance_sum"] / n
         s["load_imbalance_max"] = self.stats["imbalance_max"]
@@ -761,6 +935,13 @@ class ServingFrontend:
                                  for h in self._replicas)
                           else "inproc")
         s["worker_deaths"] = int(self.stats["worker_deaths"])
+        if self.tracer.enabled:
+            cons = self.tracer.conservation()
+            s["span_events"] = len(self.tracer)
+            s["span_conservation_ok"] = bool(cons["ok"])
+            s["span_open"] = len(cons["open"])
+            s["span_multi_terminal"] = len(cons["multi_terminal"])
+        s["incidents"] = len(self.incidents)
         if self._stall_samples:
             s["stall_recovery_max_s"] = float(max(self._stall_samples))
         if self._supervisor is not None:

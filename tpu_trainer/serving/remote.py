@@ -160,6 +160,7 @@ def rpc(sock: socket.socket, req_id: int, method: str, params: dict):
 # change after construction; identity fields rid/prompt/... stay put).
 _RUNTIME_FIELDS = (
     "status", "slot", "preemptions", "first_token_at", "finished_at",
+    "admitted_at",
     "prefill_cursor", "prefill_target", "prefill_chunk",
     "prefix_hit_tokens", "spec_drafted", "spec_accepted", "spec_steps",
 )
@@ -381,6 +382,9 @@ class RemoteReplica:
         self.dead = False
         self.block_size = handle.block_size
         self._reqs: Dict[int, Request] = {}     # unfinished mirrors
+        # Worker-side span events carried home on RPC replies, buffered
+        # until the front-end's next drain_span_events() merge.
+        self._span_pending: List[dict] = []
         self._load: Dict[str, object] = {
             "queue_depth": 0, "outstanding_tokens": 0, "has_work": False,
             "oldest_arrival": None, "generated_tokens": 0,
@@ -416,13 +420,31 @@ class RemoteReplica:
         load = result.get("load")
         if load is not None:
             self._load = load
+        # Every reply may piggyback the worker tracer's event delta —
+        # one wire, no extra round-trips (worker.py drains per handler).
+        trace = result.get("trace")
+        if trace:
+            self._span_pending.extend(trace)
         return result
+
+    def drain_span_events(self) -> List[dict]:
+        """Worker span events accumulated off RPC replies since the last
+        drain — the delta surface ``frontend.LocalReplica`` exposes from
+        its engine tracer, so the front-end merges both transports
+        identically. Timestamps are already front-end times (the worker
+        clock is the shipped ``now`` with a zero epoch)."""
+        out, self._span_pending = self._span_pending, []
+        return out
 
     # -- the replica surface the front-end consumes ------------------------
 
-    def submit(self, req: Request) -> None:
-        self._rpc("submit", {"req": request_to_wire(req),
-                             "now": self.clock()})
+    def submit(self, req: Request, trace: Optional[List[dict]] = None) -> None:
+        params = {"req": request_to_wire(req), "now": self.clock()}
+        if trace:
+            # Front-door span context (submitted/routed) travels with the
+            # request so the worker tracer holds the rid's full timeline.
+            params["trace"] = trace
+        self._rpc("submit", params)
         self._reqs[req.rid] = req
 
     def step(self) -> List[Request]:

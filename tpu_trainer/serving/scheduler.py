@@ -108,6 +108,11 @@ class Request:
     preemptions: int = 0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # First admission time (engine clock domain). Set once — re-admission
+    # after preemption keeps the original, so ``admitted_at -
+    # arrival_time`` is the request's true queue wait (the ``queue_wait``
+    # series in ``engine.request_metrics``).
+    admitted_at: Optional[float] = None
     # Wall-clock emission time of every generated token (inter-token-gap
     # telemetry: ``engine.request_metrics`` derives TPOT from the diffs).
     token_times: List[float] = dataclasses.field(default_factory=list)
@@ -173,6 +178,16 @@ class Scheduler:
         self.n_preemptions = 0
         self.prefix_hit_tokens = 0
         self.prompt_tokens = 0         # prompt tokens over all admissions
+        # Span-tracing hooks, wired by the owning engine: ``tracer`` is a
+        # serving.tracing.SpanTracer, ``now_fn`` the engine clock. The
+        # scheduler is the single funnel for admission / preemption /
+        # terminal transitions, so emitting here covers every path.
+        self.tracer = None
+        self.now_fn = None
+
+    def _emit(self, req: Request, event: str, **attrs) -> None:
+        if self.tracer is not None and self.now_fn is not None:
+            self.tracer.emit(req.rid, event, self.now_fn(), **attrs)
 
     # -- queue interface ---------------------------------------------------
 
@@ -238,6 +253,10 @@ class Scheduler:
                 self.preempt(self.running[-1])
         out = sorted(self.waiting, key=lambda r: (r.arrival_time, r.rid))
         self.waiting.clear()
+        for req in out:
+            # Handoff, not a terminal: this timeline's conservation
+            # obligation moves to whoever ingests the request next.
+            self._emit(req, "exported", generated=len(req.generated))
         return out
 
     # -- the per-iteration decision ---------------------------------------
@@ -280,6 +299,16 @@ class Scheduler:
             self.prompt_tokens += len(req.prompt)
             self.running.append(req)
             admitted.append(req)
+            if req.admitted_at is None and self.now_fn is not None:
+                req.admitted_at = self.now_fn()
+                self._emit(req, "admitted", prefix_hit=matched,
+                           queue_wait=max(
+                               0.0, req.admitted_at - req.arrival_time))
+            else:
+                # Re-admission after preemption/failover: the original
+                # queue wait stands, but the event marks the resume.
+                self._emit(req, "admitted", prefix_hit=matched,
+                           resumed=True)
         return admitted
 
     def schedule(self) -> Tuple[str, List[Request]]:
@@ -394,11 +423,13 @@ class Scheduler:
         victim.preemptions += 1
         self.n_preemptions += 1
         self.waiting.appendleft(victim)
+        self._emit(victim, "preempted", n=victim.preemptions)
 
     def retire(self, req: Request, status: str = "finished") -> None:
         assert status in TERMINAL_STATES, status
         self._vacate(req)
         req.status = status
+        self._emit(req, status, generated=len(req.generated))
 
     def cancel(self, rid: int, *, status: str = "cancelled"):
         """Retire request ``rid`` NOW with a terminal status, wherever it
@@ -412,6 +443,7 @@ class Scheduler:
             if req.rid == rid:
                 self.waiting.remove(req)
                 req.status = status
+                self._emit(req, status, generated=len(req.generated))
                 return req
         for req in self.running:
             if req.rid == rid:
@@ -434,6 +466,8 @@ class Scheduler:
                     if r.deadline is not None and now > r.deadline]:
             self.waiting.remove(req)
             req.status = "deadline_exceeded"
+            self._emit(req, "deadline_exceeded",
+                       generated=len(req.generated))
             expired.append(req)
         for req in [r for r in self.running
                     if r.deadline is not None and now > r.deadline]:

@@ -153,6 +153,13 @@ class WorkerServer:
             return {}
         if method == "submit":
             req = request_from_wire(msg["req"])
+            # Front-door trace context (submitted/routed events) rides
+            # the submit payload so this engine's tracer holds the rid's
+            # FULL timeline — ingested non-pending, so the events are
+            # never echoed back to the side that already has them.
+            ctx = msg.get("trace")
+            if ctx:
+                self.engine.tracer.ingest(ctx)
             self.engine.scheduler.add(req)
             self._reqs[req.rid] = req
             self._sent[req.rid] = len(req.generated)
@@ -244,6 +251,14 @@ class WorkerServer:
                     return              # clean disconnect
                 try:
                     result = self.handle(msg)
+                    # Piggyback the engine tracer's span-event delta on
+                    # every reply: worker-side events (admitted, prefill
+                    # chunks, first_token, spec windows, terminals)
+                    # reach the front-end timeline with zero extra
+                    # round-trips. Empty when tracing is off.
+                    trace = self.engine.tracer.drain()
+                    if trace:
+                        result["trace"] = trace
                     resp = {"id": msg.get("id"), "ok": True, "result": result}
                 except ValueError as e:
                     resp = {"id": msg.get("id"), "ok": False,

@@ -262,7 +262,8 @@ def summarize(records: List[dict]) -> dict:
         s = serves[-1]
         report["serve"] = {k: s.get(k) for k in (
             "tokens_per_s", "ttft_p50_s", "ttft_p99_s", "tpot_p50_s",
-            "tpot_p99_s", "occupancy_mean", "occupancy_max", "preemptions",
+            "tpot_p99_s", "queue_wait_p50_s", "queue_wait_p99_s",
+            "occupancy_mean", "occupancy_max", "preemptions",
             "sequential_tokens_per_s", "concurrent_speedup", "n_requests",
             "concurrency", "workload", "lane", "prefill_chunk",
             "prefix_cache", "prefill_chunks", "prefix_hit_rate",
@@ -284,6 +285,7 @@ def summarize(records: List[dict]) -> dict:
         report["frontend"] = {k: f.get(k) for k in (
             "workload", "lane", "routing", "replicas", "replicas_live",
             "tokens_per_s", "ttft_p99_s", "submitted", "accepted",
+            "queue_wait_p50_s", "queue_wait_p99_s",
             "rejected", "reject_rate", "prefix_hit_rate",
             "load_imbalance_mean", "load_imbalance_max",
             "failover_events", "failed_over_requests", "wait_age_p99_s",
@@ -336,6 +338,101 @@ def summarize(records: List[dict]) -> dict:
                 "random_prefix_hit_rate": ab.get("random_prefix_hit_rate"),
                 "tok_s_vs_random": ab.get("tok_s_vs_random"),
             }
+
+    # Serve-timeline section: kind:"span" records (serving/tracing.py,
+    # emitted by serve_bench per finished rid). Phase percentiles and the
+    # worst-p99 waterfall read the LATEST lane's spans (lanes differ in
+    # chunking/spec config, so mixing them would muddy the tail), while
+    # span conservation is checked over EVERY span in the file — a
+    # dropped terminal event is a loss regardless of which lane dropped
+    # it. Each record carries its own event list, so conservation is per
+    # record (rids repeat across lanes; cross-record grouping would
+    # false-positive on the collision).
+    spans = by_kind.get("span", [])
+    if spans:
+        last_lane = spans[-1].get("lane")
+        lane_spans = [r for r in spans if r.get("lane") == last_lane]
+        open_rids, multi = [], []
+        for r in spans:
+            kinds = [e.get("event") for e in (r.get("events") or ())]
+            if "rejected" in kinds:
+                continue
+            if not any(k in ("submitted", "admitted") for k in kinds):
+                continue
+            n_term = sum(1 for k in kinds if k in (
+                "finished", "cancelled", "deadline_exceeded", "failed"))
+            if n_term > 1:
+                multi.append(r.get("rid"))
+            elif n_term == 0 and "exported" not in kinds:
+                open_rids.append(r.get("rid"))
+        phases = {}
+        for name in ("queue_wait", "prefill", "decode", "total"):
+            vals = [r.get(f"{name}_s") for r in lane_spans
+                    if r.get(f"{name}_s") is not None]
+            if vals:
+                phases[name] = {
+                    "n": len(vals),
+                    "p50": _percentile(vals, 50),
+                    "p99": _percentile(vals, 99),
+                }
+        worst = sorted(
+            (r for r in lane_spans if r.get("total_s") is not None),
+            key=lambda r: r["total_s"], reverse=True)[:3]
+        report["spans"] = {
+            "n": len(spans),
+            "lane": last_lane,
+            "conservation_ok": not open_rids and not multi,
+            "open": open_rids[:10],
+            "multi_terminal": multi[:10],
+            "phases": phases,
+            "waterfall": [{k: r.get(k) for k in (
+                "rid", "replica", "queue_wait_s", "prefill_s",
+                "decode_s", "total_s", "n_events")} for r in worst],
+        }
+
+    # Fleet time series: kind:"serve_ts" samples (ServingLedger.record).
+    # Same latest-lane convention as the span percentiles.
+    ts = by_kind.get("serve_ts", [])
+    if ts:
+        last_lane = ts[-1].get("lane")
+        lane_ts = [r for r in ts if r.get("lane") == last_lane]
+        final = next((r for r in reversed(lane_ts) if r.get("final")),
+                     lane_ts[-1])
+        depths = [r.get("queue_depth") for r in lane_ts
+                  if r.get("queue_depth") is not None]
+        report["serve_ts"] = {
+            "n": len(lane_ts),
+            "lane": last_lane,
+            "total_seconds": final.get("total_seconds"),
+            "dispatch_frac": final.get("dispatch_frac"),
+            "host_sched_frac": final.get("host_sched_frac"),
+            "rpc_wait_frac": final.get("rpc_wait_frac"),
+            "idle_frac": final.get("idle_frac"),
+            "untracked_frac": final.get("untracked_frac"),
+            "queue_depth": _stats([float(d) for d in depths]),
+            "queue_depth_series": [float(d) for d in depths],
+            "outstanding_tokens": _stats(
+                [float(r["outstanding_tokens"]) for r in lane_ts
+                 if r.get("outstanding_tokens") is not None]),
+            "occupancy": _stats(
+                [float(r["occupancy"]) for r in lane_ts
+                 if r.get("occupancy") is not None]),
+        }
+
+    # Incidents: fence/failover/worker-death/drain-failure markers from
+    # the serving flight recorder (frontend._dump_incident).
+    incidents = by_kind.get("incident", [])
+    if incidents:
+        by_reason: Dict[str, int] = {}
+        for r in incidents:
+            by_reason[str(r.get("reason"))] = (
+                by_reason.get(str(r.get("reason")), 0) + 1)
+        report["incidents"] = {
+            "n": len(incidents),
+            "by_reason": by_reason,
+            "dumps": [r.get("dump_dir") for r in incidents
+                      if r.get("dump_dir")],
+        }
 
     decodes = by_kind.get("decode", [])
     if decodes:
@@ -449,6 +546,27 @@ def _fmt(x, nd=2, default="-"):
     if isinstance(x, float):
         return f"{x:,.{nd}f}"
     return str(x)
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(xs: List[float], width: int = 32) -> str:
+    """Down-sampled unicode sparkline of a series (mean per bucket)."""
+    xs = [x for x in xs if x is not None and math.isfinite(x)]
+    if not xs:
+        return ""
+    if len(xs) > width:
+        per = len(xs) / width
+        xs = [sum(xs[int(i * per):max(int(i * per) + 1, int((i + 1) * per))])
+              / max(1, len(xs[int(i * per):max(int(i * per) + 1,
+                                               int((i + 1) * per))]))
+              for i in range(width)]
+    lo, hi = min(xs), max(xs)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((x - lo) / span * (len(_SPARK) - 1)))] for x in xs)
 
 
 def render(report: dict) -> List[str]:
@@ -626,6 +744,65 @@ def render(report: dict) -> List[str]:
                 f" {_fmt(ab.get('random_prefix_hit_rate'))}"
                 + (f" | tok/s x{_fmt(ab.get('tok_s_vs_random'))}"
                    if ab.get("tok_s_vs_random") is not None else ""))
+    sp = report.get("spans")
+    if sp:
+        flag = "" if sp.get("conservation_ok") else (
+            f"  ** SPAN CONSERVATION BROKEN"
+            f" ({len(sp.get('open') or [])} open,"
+            f" {len(sp.get('multi_terminal') or [])} multi-terminal) **")
+        ph = sp.get("phases") or {}
+
+        def _ph(name):
+            d = ph.get(name)
+            if not d:
+                return f"{name} -"
+            return (f"{name} p50 {_fmt(d['p50'] * 1e3, 1)}ms"
+                    f" p99 {_fmt(d['p99'] * 1e3, 1)}ms")
+
+        lines.append(
+            f"spans   {sp['n']} requests (lane {sp.get('lane')})"
+            f" | {_ph('queue_wait')} | {_ph('prefill')}"
+            f" | {_ph('decode')}{flag}")
+        wf = sp.get("waterfall") or []
+        if wf:
+            lines.append("spans   worst-total waterfall"
+                         " (queue|prefill|decode, ms):")
+            for w in wf:
+                lines.append(
+                    f"spans     rid {w.get('rid')}"
+                    + (f" r{w.get('replica')}"
+                       if w.get("replica") is not None else "")
+                    + f"  {_fmt((w.get('queue_wait_s') or 0) * 1e3, 1)}"
+                    + f" | {_fmt((w.get('prefill_s') or 0) * 1e3, 1)}"
+                    + f" | {_fmt((w.get('decode_s') or 0) * 1e3, 1)}"
+                    + f"  = {_fmt((w.get('total_s') or 0) * 1e3, 1)}"
+                    + f" ({w.get('n_events')} events)")
+    sts = report.get("serve_ts")
+    if sts:
+        parts = []
+        for k in ("dispatch", "host_sched", "rpc_wait", "idle"):
+            v = sts.get(f"{k}_frac")
+            if v is not None:
+                parts.append(f"{k} {_fmt(v * 100, 1)}%")
+        parts.append(
+            f"untracked {_fmt((sts.get('untracked_frac') or 0) * 100, 1)}%")
+        lines.append(
+            f"serve_ts {sts['n']} samples over"
+            f" {_fmt(sts.get('total_seconds'), 1)}s | " + "  ".join(parts))
+        qd = sts.get("queue_depth")
+        if qd:
+            spark = _sparkline(sts.get("queue_depth_series") or [])
+            lines.append(
+                f"serve_ts queue depth p50 {_fmt(qd['p50'], 1)}"
+                f" p90 {_fmt(qd['p90'], 1)}"
+                + (f"  {spark}" if spark else ""))
+    inc = report.get("incidents")
+    if inc:
+        reasons = "  ".join(f"{k} x{v}"
+                            for k, v in sorted(inc["by_reason"].items()))
+        lines.append(
+            f"incidents {inc['n']} ({reasons})"
+            + (f" | dumps: {len(inc['dumps'])}" if inc.get("dumps") else ""))
     src = report.get("sources")
     if src:
         parts = "  ".join(
@@ -676,7 +853,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             reject_tol: float = 0.05,
             rpc_overhead_tol: float = 1.0,
             deadline_miss_tol: float = 0.05,
-            stall_recovery_tol: float = 30.0) -> List[dict]:
+            stall_recovery_tol: float = 30.0,
+            queue_wait_tol: float = 1.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -1087,6 +1265,53 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "tolerance_s": stall_recovery_tol,
             "absolute": True,
         })
+
+    # Queue-wait p99 is ABSOLUTE against a fixed budget: admission-to-
+    # arrival latency is an SLO input, not a baseline-relative number —
+    # a queue that was already slow must not grandfather itself in.
+    # Preferred source: the span-trace phase percentiles (spans carry
+    # the true first-admission wait even across failover); falls back to
+    # the serve/frontend records' queue_wait series. SKIP when the run
+    # traced no queue waits at all.
+    new_qw = get(new, "spans", "phases", "queue_wait", "p99")
+    if new_qw is None:
+        new_qw = get(new, "serve", "queue_wait_p99_s")
+    if new_qw is None:
+        new_qw = get(new, "frontend", "queue_wait_p99_s")
+    if new_qw is None:
+        verdicts.append({"metric": "serve_queue_wait_p99",
+                         "verdict": "SKIP",
+                         "base": get(base, "spans", "phases",
+                                     "queue_wait", "p99"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "serve_queue_wait_p99",
+            "verdict": "FAIL" if new_qw > queue_wait_tol + eps else "PASS",
+            "base": get(base, "spans", "phases", "queue_wait", "p99"),
+            "new": round(new_qw, 5),
+            "tolerance_s": queue_wait_tol,
+            "absolute": True,
+        })
+
+    # Span conservation is CATEGORICAL: every opened rid in the new
+    # run's span records must close with exactly one terminal event
+    # (or an explicit handoff). A dropped or doubled terminal is a
+    # bookkeeping bug whatever the baseline did. SKIP when the run
+    # emitted no span records.
+    new_cons = get(new, "spans", "conservation_ok")
+    if new_cons is None:
+        verdicts.append({"metric": "span_conservation", "verdict": "SKIP",
+                         "base": get(base, "spans", "conservation_ok"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "span_conservation",
+            "verdict": "PASS" if new_cons else "FAIL",
+            "base": get(base, "spans", "conservation_ok"),
+            "new": bool(new_cons),
+            "absolute": True,
+        })
     return verdicts
 
 
@@ -1200,6 +1425,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "fenced at the RPC timeout, or death mid-"
                              "call) exceeds this many seconds (default "
                              "30); SKIP when the run had no such stall")
+    parser.add_argument("--queue-wait-tol", type=float, default=1.0,
+                        help="ABSOLUTE gate on serving queue wait: FAIL if "
+                             "the new run's p99 admission-to-arrival wait "
+                             "(span traces, else the serve/frontend "
+                             "queue_wait series) exceeds this many seconds "
+                             "(default 1.0); SKIP when the run traced no "
+                             "queue waits. Span conservation needs no "
+                             "tolerance: an opened rid without exactly one "
+                             "terminal event is a categorical FAIL")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -1229,7 +1463,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             reject_tol=args.reject_tol,
             rpc_overhead_tol=args.rpc_overhead_tol,
             deadline_miss_tol=args.deadline_miss_tol,
-            stall_recovery_tol=args.stall_recovery_tol)
+            stall_recovery_tol=args.stall_recovery_tol,
+            queue_wait_tol=args.queue_wait_tol)
 
     if args.json:
         print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))

@@ -63,10 +63,15 @@ class WindowedTrace:
     steps or at ``close()``, and never re-opens (one window per run).
     """
 
-    def __init__(self, log_dir: Optional[str], start: int = 5, num_steps: int = 5):
+    def __init__(self, log_dir: Optional[str], start: int = 5,
+                 num_steps: int = 5, label: str = "train"):
         self.log_dir = log_dir
         self.start = start
         self.num_steps = num_steps
+        # Annotation label grouping the trace-viewer timeline: "train"
+        # for training steps, "serve" for serving iterations
+        # (serve_bench --profile-trace).
+        self.label = label
         self._active = False
         self._stop_at: Optional[int] = None   # set when the window opens
 
@@ -81,7 +86,7 @@ class WindowedTrace:
                 jax.profiler.stop_trace()
                 self._active = False
         if self._active:
-            return jax.profiler.StepTraceAnnotation("train", step_num=i)
+            return jax.profiler.StepTraceAnnotation(self.label, step_num=i)
         return contextlib.nullcontext()
 
     def close(self) -> None:
