@@ -78,6 +78,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 import time
 import zlib
 
@@ -298,6 +299,14 @@ def main(argv=None) -> int:
                    help="front-end lanes: dump flight-recorder incident "
                         "reports (failover / worker death / fence / "
                         "drain failure) into DIR/<lane>/...")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="front-end lanes: serve /metrics + /healthz + "
+                        "/statusz on PORT (0 = ephemeral) for each timed "
+                        "lane, scrape it live from a sidecar thread, and "
+                        "gate on (a) every scrape answering under 1s even "
+                        "mid-failover and (b) the terminal counters of "
+                        "the final scrape agreeing EXACTLY with the "
+                        "drain-time summary")
     p.add_argument("--no-trace", action="store_true",
                    help="disable span tracing + serve_ts telemetry (the "
                         "bit-identity A/B for 'tracing is free'; on by "
@@ -307,6 +316,10 @@ def main(argv=None) -> int:
     if args.profile_trace and (args.replicas > 0 or args.workers > 0):
         p.error("--profile-trace profiles the single-engine serve loop; "
                 "drop --replicas/--workers to use it")
+    if args.metrics_port is not None and not (
+            args.replicas > 0 or args.workers > 0):
+        p.error("--metrics-port drives the front-end lanes; add "
+                "--replicas N or --workers N to use it")
 
     if args.workers > 0:
         if args.replicas > 0 and args.replicas != args.workers:
@@ -553,6 +566,10 @@ def main(argv=None) -> int:
             "prefix_hit_tokens": int(summary["prefix_hit_tokens"]),
             "prefix_hit_rate": round(summary["prefix_hit_rate"], 4),
             "prefix_evictions": int(summary["prefix_evictions"]),
+            "pool_free_blocks": int(summary["pool_free_blocks"]),
+            "pool_evictable_blocks": int(summary["pool_evictable_blocks"]),
+            "pool_referenced_blocks": int(summary["pool_referenced_blocks"]),
+            "prefix_index_entries": int(summary["prefix_index_entries"]),
         }
         if spec != "off":
             record.update({
@@ -796,6 +813,78 @@ def _analyze_out(path: str) -> None:
         print(f"serve_bench: {line}", file=sys.stderr, flush=True)
 
 
+def _http_get(url: str, timeout: float = 5.0):
+    """GET ``url``; returns ``(status_code, body_text)``. HTTP error
+    statuses are answers, not exceptions (a healthz 503 IS the datum
+    the readiness-flip gate wants)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _parse_prom(text: str) -> dict:
+    """Prometheus v0.0.4 text → ``{'name{labels}': float}`` (comment
+    lines skipped). Just enough to compare scraped counters against
+    the drain-time summary."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+class _MetricsScraper:
+    """Sidecar thread scraping a live lane's ``/metrics`` + ``/healthz``.
+
+    Polls every ``period_s``, recording per-scrape wall latency, any
+    transport errors, and every healthz status code observed. The gate
+    it feeds: the telemetry plane is host-side and lock-bounded, so a
+    scrape must answer fast even while a worker is being SIGKILLed and
+    its streams replayed — a stall past 1 s counts as an outage."""
+
+    def __init__(self, url: str, period_s: float = 0.05):
+        self.url = url
+        self.period_s = period_s
+        self.latencies: list = []
+        self.errors: list = []
+        self.healthz_codes: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="bench-metrics-scraper", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                code, _ = _http_get(self.url + "/metrics", timeout=5.0)
+                self.latencies.append(time.perf_counter() - t0)
+                if code != 200:
+                    self.errors.append(f"/metrics -> {code}")
+            except Exception as e:
+                self.errors.append(f"/metrics: {type(e).__name__}: {e}")
+            try:
+                code, _ = _http_get(self.url + "/healthz", timeout=5.0)
+                self.healthz_codes.add(code)
+            except Exception as e:
+                self.errors.append(f"/healthz: {type(e).__name__}: {e}")
+            self._stop.wait(self.period_s)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+
+
 def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     """Multi-replica lanes (``--replicas N``): the same trace through the
     serving front-end, one lane per routing policy (``--ab``: random vs
@@ -855,13 +944,14 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         supervisors.append(sup)
         return sup
 
-    def build(routing, sup=None, incident_dir=None):
+    def build(routing, sup=None, incident_dir=None, registry=None):
         return ServingFrontend(
             params, cfg, replicas=args.replicas, routing=routing,
             max_queue_depth=args.max_queue or max(args.requests, 1),
             wait_watermark=args.wait_watermark or None,
             seed=args.seed, replica_factory=sup,
             trace=not args.no_trace, incident_dir=incident_dir,
+            registry=registry,
             **engine_kwargs,
         )
 
@@ -877,12 +967,17 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         return trace
 
     obs_records = []   # kind:"span"/"serve_ts"/"incident" riding --out
+    metrics_failures = []   # --metrics-port gate violations, all lanes
 
     def run_lane(lane, routing, fault_spec=None, transport="inproc"):
         # Incidents dump per lane (the warm-up front-end gets no dir: a
         # compile-run artifact would shadow the timed drill's dump).
         inc_dir = (os.path.join(args.incident_dir, lane)
                    if args.incident_dir else None)
+        registry = None
+        if args.metrics_port is not None:
+            from tpu_trainer.obs.metrics import MetricsRegistry
+            registry = MetricsRegistry()
         if transport == "rpc":
             # Warm-up compiles inside the worker PROCESSES, so they must
             # survive into the timed run: reset() rebuilds each worker's
@@ -891,15 +986,30 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             sup = make_supervisor()
             build(routing, sup).run(make_trace())
             sup.reset()
-            fe = build(routing, sup, incident_dir=inc_dir)
+            fe = build(routing, sup, incident_dir=inc_dir,
+                       registry=registry)
         else:
             build(routing).run(make_trace())   # warm-up: compiles shapes
-            fe = build(routing, incident_dir=inc_dir)
-        if fault_spec:
-            with faults.plan(fault_spec):
+            fe = build(routing, incident_dir=inc_dir, registry=registry)
+        mserver = scraper = None
+        if registry is not None:
+            from tpu_trainer.obs.http import MetricsServer
+
+            # The timed front-end only: the scrape plane watches the
+            # drill itself, probes readiness off live replica count.
+            mserver = MetricsServer(registry, port=args.metrics_port,
+                                    statusz_fn=fe.statusz)
+            mserver.health.add_probe("replicas_live", fe.ready)
+            scraper = _MetricsScraper(mserver.url)
+        try:
+            if fault_spec:
+                with faults.plan(fault_spec):
+                    finished = fe.run(timed_trace())
+            else:
                 finished = fe.run(timed_trace())
-        else:
-            finished = fe.run(timed_trace())
+        finally:
+            if scraper is not None:
+                scraper.stop()
         s = fe.summary()
         lat = request_metrics(finished)
         # Conservation at drain: every ACCEPTED request reached exactly
@@ -982,6 +1092,56 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             inc = dict(inc)
             inc["lane"] = lane
             obs_records.append(inc)
+        if mserver is not None:
+            # Final scrape AFTER drain: every frontend_* counter is a
+            # set_function mirror of the same stats summary() reads, so
+            # the contract is exact equality, not a tolerance.
+            final = _parse_prom(
+                _http_get(mserver.url + "/metrics", timeout=5.0)[1])
+            expect = {
+                f'frontend_requests_total{{event="{ev}"}}': int(s[ev])
+                for ev in ("submitted", "accepted", "rejected", "finished",
+                           "cancelled", "deadline_exceeded", "failed")}
+            expect["frontend_failover_events_total"] = int(
+                s["failover_events"])
+            expect["frontend_worker_deaths_total"] = int(
+                s["worker_deaths"])
+            if "fenced" in s:
+                expect["frontend_fenced_total"] = int(s["fenced"])
+            for key, want in sorted(expect.items()):
+                got = final.get(key, 0.0)
+                if int(got) != want:
+                    metrics_failures.append(
+                        f"lane {lane}: scraped {key} = {int(got)} != "
+                        f"drain summary {want}")
+            if scraper.errors:
+                metrics_failures.append(
+                    f"lane {lane}: {len(scraper.errors)} scrape errors "
+                    f"(first: {scraper.errors[0]})")
+            if not scraper.latencies:
+                metrics_failures.append(
+                    f"lane {lane}: no successful mid-run /metrics scrape")
+            max_lat = max(scraper.latencies, default=0.0)
+            if max_lat > 1.0:
+                metrics_failures.append(
+                    f"lane {lane}: /metrics stalled {max_lat:.3f}s > 1s "
+                    f"during the drill")
+            if 200 not in scraper.healthz_codes:
+                metrics_failures.append(
+                    f"lane {lane}: /healthz never reported ready (codes "
+                    f"seen: {sorted(scraper.healthz_codes)})")
+            # Teardown readiness flip: liveness off must read 503 while
+            # the listener is still up (the final-scrape race).
+            mserver.health.set_live(False)
+            code, _ = _http_get(mserver.url + "/healthz", timeout=5.0)
+            if code != 503:
+                metrics_failures.append(
+                    f"lane {lane}: /healthz returned {code} after the "
+                    f"liveness flip (want 503)")
+            record["metrics_port"] = mserver.port
+            record["metrics_scrapes"] = len(scraper.latencies)
+            record["metrics_scrape_max_s"] = round(max_lat, 4)
+            mserver.close()
         ttfts = {r.rid: r.first_token_at - r.arrival_time
                  for r in finished if r.first_token_at is not None}
         return record, drained, ttfts
@@ -1088,6 +1248,7 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         if p99 is None or p99 > args.ttft_p99_gate:
             failures.append(
                 f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
+    failures.extend(metrics_failures)
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
     return 1 if failures else 0
@@ -1121,6 +1282,10 @@ def _print_frontend_record(r) -> None:
     if "ttft_p50_s" in r:
         print(f"TTFT    p50 {r['ttft_p50_s'] * 1e3:8.1f} ms   "
               f"p99 {r['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
+    if r.get("metrics_scrapes") is not None:
+        print(f"metrics {r['metrics_scrapes']} live scrapes on "
+              f":{r['metrics_port']}, max latency "
+              f"{r['metrics_scrape_max_s'] * 1e3:.1f} ms", flush=True)
     if r.get("span_conservation_ok") is not None or r.get("incidents"):
         print(f"spans   {r.get('span_events', 0)} events, conservation "
               f"{'ok' if r.get('span_conservation_ok') else 'BROKEN'} | "

@@ -184,4 +184,20 @@ echo "chaos: incident dump at $DUMP"
 python -m tpu_trainer.tools.analyze "$INC_OUT" \
   --compare "$INC_OUT" --reject-tol 0.0 --queue-wait-tol 60.0
 
+# 12. Live telemetry plane: the worker-kill drill once more with the
+#     /metrics + /healthz endpoint up on an ephemeral port and a
+#     sidecar scraper hammering it through the failover. The bench
+#     itself exits 1 if any scrape stalls past 1s while the worker is
+#     being killed, if /healthz never reads ready (or fails to flip to
+#     503 at teardown), or if the terminal counters of the final scrape
+#     disagree with the drain-time summary by even one request —
+#     conservation must hold on the wire exactly as it does in memory.
+OBS_OUT="$OUT/live_metrics.jsonl"
+rm -f "$OBS_OUT"
+echo "== chaos: live telemetry plane (scrape during worker-kill) =="
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --workers 2 --worker-kill 6 --metrics-port 0 --out "$OBS_OUT"
+python -m tpu_trainer.tools.analyze "$OBS_OUT" \
+  --compare "$OBS_OUT" --reject-tol 0.0 --queue-wait-tol 60.0
+
 echo "chaos: full matrix clean ($OUT)"

@@ -668,3 +668,70 @@ class TestSpecGate:
         weak = _write(tmp_path / "w.jsonl",
                       _run_records() + [self._serve_record(accept_mean=0.0)])
         assert analyze.main([weak, "--compare", base]) == 0
+
+
+class TestJsonOutput:
+    """``--json``: the machine-readable gate envelope. The contract a CI
+    caller parses: top-level ``report`` / ``verdicts`` / ``gate`` /
+    ``exit_code`` keys, one verdict row per gate with PASS/FAIL/SKIP and
+    the evaluated values + tolerance, and ``exit_code`` agreeing with
+    the process exit code byte-for-byte."""
+
+    def _json(self, capsys):
+        out = capsys.readouterr().out
+        return json.loads(out)
+
+    def test_report_only_envelope(self, tmp_path, capsys):
+        path = _write(tmp_path / "run.jsonl", _run_records())
+        assert analyze.main([path, "--json"]) == 0
+        env = self._json(capsys)
+        assert set(env) == {"report", "verdicts", "gate", "exit_code"}
+        assert env["verdicts"] is None and env["gate"] is None
+        assert env["exit_code"] == 0
+        assert env["report"]["train"]["tok_per_sec"]["p50"] == 1000.0
+
+    def test_compare_pass_verdict_rows(self, tmp_path, capsys):
+        base = _write(tmp_path / "base.jsonl", _run_records())
+        new = _write(tmp_path / "new.jsonl", _run_records())
+        assert analyze.main([new, "--compare", base, "--json"]) == 0
+        env = self._json(capsys)
+        assert env["exit_code"] == 0
+        verdicts = env["verdicts"]
+        assert isinstance(verdicts, list) and verdicts
+        for v in verdicts:
+            assert v["verdict"] in ("PASS", "FAIL", "SKIP")
+            assert "metric" in v
+        tok = next(v for v in verdicts if v["metric"] == "tok_per_sec_p50")
+        assert tok["verdict"] == "PASS"
+        assert tok["base"] == 1000.0 and tok["new"] == 1000.0
+        assert tok["tolerance_pct"] == 10.0
+        gate = env["gate"]
+        assert set(gate) == {"PASS", "FAIL", "SKIP"}
+        assert sum(gate.values()) == len(verdicts)
+        assert gate["FAIL"] == 0
+
+    def test_compare_fail_sets_exit_code(self, tmp_path, capsys):
+        base = _write(tmp_path / "base.jsonl", _run_records(tok=1000.0))
+        new = _write(tmp_path / "new.jsonl", _run_records(tok=850.0))
+        assert analyze.main([new, "--compare", base, "--json"]) == 1
+        env = self._json(capsys)
+        assert env["exit_code"] == 1
+        assert env["gate"]["FAIL"] >= 1
+        tok = next(v for v in env["verdicts"]
+                   if v["metric"] == "tok_per_sec_p50")
+        assert tok["verdict"] == "FAIL"
+        assert tok["delta_pct"] == -15.0
+
+    def test_json_cli_subprocess_round_trip(self, tmp_path):
+        # The documented entrypoint, parsed the way CI would: stdout is
+        # ONE JSON document, nothing else mixed in.
+        path = _write(tmp_path / "run.jsonl", _run_records())
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_trainer.tools.analyze",
+             path, "--compare", path, "--json"],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        env = json.loads(proc.stdout)
+        assert env["exit_code"] == 0
+        assert env["gate"]["FAIL"] == 0

@@ -53,6 +53,7 @@ import numpy as np
 
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.models.gpt import GPT, init_paged_cache
+from tpu_trainer.obs.metrics import NULL_REGISTRY
 from tpu_trainer.serving.paged_cache import PagedKVCache
 from tpu_trainer.serving.sampling import sample_tokens
 from tpu_trainer.serving.scheduler import Request, SamplingParams, Scheduler
@@ -102,6 +103,7 @@ class ServingEngine:
         trace: bool = True,
         ts_interval: int = 32,
         metric_logger=None,
+        registry=None,
     ):
         if spec not in ("off", "ngram", "draft"):
             raise ValueError(f"spec={spec!r} (off | ngram | draft)")
@@ -190,6 +192,75 @@ class ServingEngine:
             "finished": 0, "cancelled": 0, "deadline_exceeded": 0,
             "failed": 0,
         }
+        # Live metrics plane (obs/): counters and gauges mirror the
+        # cumulative stats above via set_function — read at scrape time,
+        # zero hot-path cost, and exact agreement with summary() by
+        # construction. Only the latency histograms observe inline, and
+        # those sites are no-op method calls on the null registry.
+        self.registry = registry if registry is not None else NULL_REGISTRY
+        self._metrics_on = registry is not None
+        self._install_metrics()
+
+    def _install_metrics(self) -> None:
+        reg = self.registry
+        self._m_step_seconds = reg.histogram(
+            "serve_step_seconds", "Engine step wall-clock latency")
+        self._m_ttft = reg.histogram(
+            "serve_ttft_seconds", "Time to first token (engine clock)")
+        self._m_tpot = reg.histogram(
+            "serve_tpot_seconds", "Inter-token gap (engine clock)")
+        req_total = reg.counter(
+            "serve_requests_total", "Terminal requests by state",
+            labelnames=("state",))
+        for state in self.scheduler.terminal_counts:
+            req_total.labels(state=state).set_function(
+                lambda s=state: self.scheduler.terminal_counts[s])
+        reg.counter("serve_admissions_total", "Admission events "
+                    "(re-admission after preemption/failover counts)"
+                    ).set_function(lambda: self.scheduler.n_admissions)
+        reg.counter("serve_preemptions_total", "Recompute preemptions"
+                    ).set_function(lambda: self.scheduler.n_preemptions)
+        reg.counter("serve_generated_tokens_total", "Tokens emitted"
+                    ).set_function(lambda: self.stats["generated_tokens"])
+        reg.counter("serve_prefill_tokens_total", "Prompt tokens prefilled"
+                    ).set_function(lambda: self.stats["prefill_tokens"])
+        reg.counter("serve_prompt_tokens_total", "Prompt tokens admitted"
+                    ).set_function(lambda: self.scheduler.prompt_tokens)
+        reg.counter("serve_prefix_hit_tokens_total",
+                    "Prompt tokens served from the prefix index"
+                    ).set_function(lambda: self.scheduler.prefix_hit_tokens)
+        reg.counter("serve_prefix_evictions_total", "Prefix-index evictions"
+                    ).set_function(
+                        lambda: self.cache_state.n_prefix_evictions)
+        pool = reg.gauge("serve_pool_blocks",
+                         "Paged-pool fragmentation split",
+                         labelnames=("kind",))
+        pool.labels(kind="free").set_function(
+            lambda: self.cache_state.pool.free_blocks)
+        pool.labels(kind="evictable").set_function(
+            lambda: self.cache_state.evictable_blocks)
+        pool.labels(kind="referenced").set_function(
+            lambda: self.cache_state.referenced_blocks)
+        reg.gauge("serve_pool_occupancy", "Paged-pool occupancy fraction"
+                  ).set_function(lambda: self.cache_state.pool.occupancy)
+        reg.gauge("serve_prefix_index_entries", "Prefix-index size"
+                  ).set_function(
+                      lambda: self.cache_state.prefix_index_entries)
+        reg.gauge("serve_queue_depth", "Requests waiting for admission"
+                  ).set_function(lambda: self.queue_depth)
+        reg.gauge("serve_running", "Requests in flight"
+                  ).set_function(lambda: len(self.scheduler.running))
+        reg.gauge("serve_outstanding_tokens", "Token-steps of work owed"
+                  ).set_function(lambda: self.outstanding_tokens)
+        if self.spec_decoder is not None:
+            reg.counter("serve_spec_drafted_total", "Draft tokens proposed"
+                        ).set_function(lambda: self.stats["spec_drafted"])
+            reg.counter("serve_spec_accepted_total", "Draft tokens accepted"
+                        ).set_function(lambda: self.stats["spec_accepted"])
+            reg.gauge("serve_spec_accept_rate",
+                      "Accepted / drafted (cumulative)").set_function(
+                          lambda: self.stats["spec_accepted"]
+                          / max(1, int(self.stats["spec_drafted"])))
 
     def reset_stats(self) -> None:
         """Zero counters/clock between a warm-up run and a timed run. The
@@ -199,8 +270,11 @@ class ServingEngine:
         self._iters = 0
         self._t0 = None
         self.scheduler.n_preemptions = 0
+        self.scheduler.n_admissions = 0
         self.scheduler.prefix_hit_tokens = 0
         self.scheduler.prompt_tokens = 0
+        for k in self.scheduler.terminal_counts:
+            self.scheduler.terminal_counts[k] = 0
         self.cache_state.n_prefix_evictions = 0
         self.wall_elapsed = 0.0
         self._deadline_margins = []
@@ -219,6 +293,15 @@ class ServingEngine:
         a terminal state this iteration: finished streams, plus anything
         the deadline sweep retired at the boundary (their blocks are
         already back in the pool)."""
+        if not self._metrics_on:
+            return self._step_impl()
+        t0 = time.perf_counter()
+        try:
+            return self._step_impl()
+        finally:
+            self._m_step_seconds.observe(time.perf_counter() - t0)
+
+    def _step_impl(self) -> List[Request]:
         self._iters += 1
         with self.ledger.track("host_sched"):
             terminal = self._expire_deadlines()
@@ -366,6 +449,8 @@ class ServingEngine:
                     # index), so the stream matches an unchunked pass.
                     continue
             tok = int(tokens[r.slot])
+            if r.token_times:
+                self._m_tpot.observe(max(0.0, now - r.token_times[-1]))
             r.generated.append(tok)
             r.token_times.append(now)
             self.stats["generated_tokens"] += 1
@@ -373,6 +458,7 @@ class ServingEngine:
             cs.lengths[r.slot] = r.context_len() - 1
             if r.first_token_at is None:
                 r.first_token_at = now
+                self._m_ttft.observe(max(0.0, now - r.arrival_time))
                 self.tracer.emit(r.rid, "first_token", now)
             if (r.eos_id is not None and tok == r.eos_id) or (
                 len(r.generated) >= r.max_new_tokens
@@ -477,10 +563,13 @@ class ServingEngine:
             for tok in emitted[r.slot, :j + 1]:
                 tok = int(tok)
                 r.generated.append(tok)
+                if r.token_times:
+                    self._m_tpot.observe(max(0.0, now - r.token_times[-1]))
                 r.token_times.append(now)
                 self.stats["generated_tokens"] += 1
                 if r.first_token_at is None:
                     r.first_token_at = now
+                    self._m_ttft.observe(max(0.0, now - r.arrival_time))
                     self.tracer.emit(r.rid, "first_token", now)
                 if (r.eos_id is not None and tok == r.eos_id) or (
                     len(r.generated) >= r.max_new_tokens
@@ -651,6 +740,7 @@ class ServingEngine:
             / max(1, self.scheduler.prompt_tokens)
         )
         s["prefix_evictions"] = self.cache_state.n_prefix_evictions
+        s.update(self.cache_state.fragmentation())
         s["queue_depth"] = self.queue_depth
         s["outstanding_tokens"] = self.outstanding_tokens
         s["oldest_wait_s"] = (

@@ -98,6 +98,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.obs.metrics import NULL_REGISTRY, MetricsRegistry
 from tpu_trainer.serving.engine import ServingEngine
 from tpu_trainer.serving.paged_cache import chained_block_digests
 from tpu_trainer.serving.remote import ReplicaDied
@@ -176,6 +177,13 @@ class LocalReplica:
         the front-end merges both transports identically."""
         return self.engine.tracer.drain()
 
+    def metrics_snapshot(self) -> dict:
+        """The engine registry's resolved snapshot — same surface as
+        ``RemoteReplica.metrics_snapshot`` (which pulls it over the
+        ``metrics`` RPC verb), so the front-end merges both transports
+        identically."""
+        return self.engine.registry.snapshot()
+
     def release(self) -> None:
         self.engine.device_cache = None   # drop the KV pools
 
@@ -241,6 +249,8 @@ class ServingFrontend:
         incident_dir: Optional[str] = None,
         ring_capacity: int = 256,
         metric_logger=None,
+        registry=None,
+        metrics_pull_every: int = 16,
         **engine_kwargs,
     ):
         if replicas < 1:
@@ -320,9 +330,121 @@ class ServingFrontend:
             "imbalance_sum": 0.0, "imbalance_samples": 0,
             "imbalance_max": 0.0,
         }
+        # Live metrics plane: front-door counters mirror ``stats`` via
+        # set_function (zero hot-path cost, exact agreement with
+        # summary()); per-replica engine registries are pulled and
+        # merged label-wise (replica=N) every ``metrics_pull_every``
+        # iterations — from the MAIN thread only, so the scrape thread
+        # never races an RPC socket. Off (registry=None) ⇒ a null
+        # registry and no pulls: bit-identical to a run without it.
+        self.registry = registry if registry is not None else NULL_REGISTRY
+        self._metrics_on = registry is not None
+        self.metrics_pull_every = max(1, int(metrics_pull_every))
+        self._install_metrics()
         for _ in range(replicas):
             self._spawn_replica()
         self.block_size = self._replicas[0].engine.block_size
+
+    def _install_metrics(self) -> None:
+        reg = self.registry
+        req = reg.counter("frontend_requests_total",
+                          "Front-door request events", labelnames=("event",))
+        for ev in ("submitted", "accepted", "rejected", "finished",
+                   "cancelled", "deadline_exceeded", "failed"):
+            req.labels(event=ev).set_function(
+                lambda e=ev: self.stats[e])
+        rej = reg.counter("frontend_rejects_total",
+                          "Admission rejects by tripped limit",
+                          labelnames=("reason",))
+        for reason in ("queue_full", "wait_watermark"):
+            rej.labels(reason=reason).set_function(
+                lambda r=reason: self.stats[f"rejected_{r}"])
+        for name, key, help_ in (
+                ("frontend_failover_events_total", "failover_events",
+                 "Replica failovers"),
+                ("frontend_failed_over_requests_total",
+                 "failed_over_requests", "Requests moved by failover"),
+                ("frontend_worker_deaths_total", "worker_deaths",
+                 "Worker process deaths (killed, fenced, or crashed)"),
+                ("frontend_grows_total", "grows", "Replicas added"),
+                ("frontend_shrinks_total", "shrinks", "Replicas drained"),
+                ("frontend_retired_replicas_total", "retired_replicas",
+                 "Draining replicas torn down")):
+            reg.counter(name, help_).set_function(
+                lambda k=key: self.stats[k])
+        reg.counter("frontend_fenced_total",
+                    "Suspect workers fenced (SIGKILL) after a hung RPC"
+                    ).set_function(
+                        lambda: getattr(self._supervisor, "n_fenced", 0)
+                        if self._supervisor is not None else 0)
+        reg.counter("frontend_incidents_total", "Incident records"
+                    ).set_function(lambda: len(self.incidents))
+        rep = reg.gauge("frontend_replicas", "Replica set by state",
+                        labelnames=("state",))
+        rep.labels(state="live").set_function(lambda: len(self._live()))
+        rep.labels(state="draining").set_function(
+            lambda: sum(1 for h in self._replicas
+                        if h.alive and h.draining))
+        rep.labels(state="dead").set_function(
+            lambda: sum(1 for h in self._replicas if not h.alive))
+        reg.gauge("frontend_queue_depth", "Fleet queued requests"
+                  ).set_function(
+                      lambda: sum(h.engine.queue_depth
+                                  for h in self._replicas if h.alive))
+        reg.gauge("frontend_outstanding_tokens",
+                  "Fleet token-steps of work owed").set_function(
+                      lambda: sum(h.engine.outstanding_tokens
+                                  for h in self._replicas if h.alive))
+        reg.gauge("frontend_in_flight", "Accepted, not yet terminal"
+                  ).set_function(
+                      lambda: self.stats["accepted"]
+                      - self.stats["finished"] - self.stats["cancelled"]
+                      - self.stats["deadline_exceeded"]
+                      - self.stats["failed"])
+
+    def ready(self) -> bool:
+        """Readiness for /healthz: at least one live replica. Flips
+        false once the fleet drains to nothing (every replica released)
+        — the state serve_bench asserts after close."""
+        return any(h.alive for h in self._replicas)
+
+    def statusz(self) -> dict:
+        """The /statusz payload: fleet summary plus per-replica pool
+        fragmentation where visible (local replicas read their engine;
+        remote ones report through the merged registry instead)."""
+        out = {"kind": "serving_frontend", "iter": self._iters}
+        out["summary"] = {
+            k: v for k, v in self.summary().items() if k != "per_replica"}
+        out["replicas"] = [
+            {"replica": h.rid, "alive": h.alive, "draining": h.draining,
+             "finished": h.finished}
+            for h in self._replicas]
+        for h, rec in zip(self._replicas, out["replicas"]):
+            if h.alive and isinstance(h.engine, LocalReplica):
+                rec.update(h.engine.engine.cache_state.fragmentation())
+        return out
+
+    def pull_metrics(self) -> None:
+        """Merge every live replica's registry snapshot into the
+        front-end registry (labels gain ``replica=N``). MAIN thread
+        only — a pull is an RPC on remote fleets, and RPC frames must
+        never interleave with the step loop's. A replica that dies
+        mid-pull is settled through the normal failover path."""
+        if not self._metrics_on:
+            return
+        for h in list(self._replicas):
+            if not h.alive:
+                continue
+            snap_fn = getattr(h.engine, "metrics_snapshot", None)
+            if snap_fn is None:
+                return   # custom replica without the surface: skip all
+            try:
+                snap = snap_fn()
+            except ReplicaDied:
+                self.stats["worker_deaths"] += 1
+                self.kill_replica(h.rid, reason="rpc_death")
+                continue
+            self.registry.merge(snap, extra_labels={"replica": h.rid})
 
     # -- replica set -------------------------------------------------------
 
@@ -338,8 +460,13 @@ class ServingFrontend:
         if self._replica_factory is not None:
             rep = self._replica_factory(rid, self._now)
         else:
+            kw = dict(self._engine_kwargs)
+            if self._metrics_on:
+                # Per-engine registry, merged into ours label-wise on
+                # each pull — the same shape as a worker process's.
+                kw.setdefault("registry", MetricsRegistry())
             eng = ServingEngine(self.params, self.config, clock=self._now,
-                                **self._engine_kwargs)
+                                **kw)
             eng._t0 = 0.0
             rep = LocalReplica(eng)
         h = _Replica(rid=rid, engine=rep)
@@ -763,6 +890,9 @@ class ServingFrontend:
         self.stats["finished"] += len(finished)
         with self.ledger.track("host_sched"):
             self._sample_load()
+            if (self._metrics_on
+                    and self._iters % self.metrics_pull_every == 0):
+                self.pull_metrics()
         if self.ts_interval and self._iters % self.ts_interval == 0:
             self._emit_ts()
         return finished
@@ -820,6 +950,7 @@ class ServingFrontend:
                 raise RuntimeError(
                     f"front-end did not drain in {max_iters} iters")
         self._reap_draining()
+        self.pull_metrics()
         return finished
 
     # -- trace replay ------------------------------------------------------
@@ -859,6 +990,7 @@ class ServingFrontend:
                 raise RuntimeError(
                     f"front-end did not drain in {max_iters} iters")
         self._reap_draining()
+        self.pull_metrics()
         self.wall_elapsed = self.clock() - t_start
         if self.ts_interval:
             self._emit_ts(final=True)

@@ -253,6 +253,27 @@ class PagedKVCache:
         admission budget."""
         return self.pool.free_blocks + self.evictable_blocks
 
+    @property
+    def referenced_blocks(self) -> int:
+        """Used blocks pinned by a live request (not reclaimable even by
+        prefix eviction). free + evictable + referenced == pool blocks."""
+        return self.pool.used_blocks - self.evictable_blocks
+
+    @property
+    def prefix_index_entries(self) -> int:
+        return len(self._prefix)
+
+    def fragmentation(self) -> dict:
+        """Free / evictable / referenced split of the pool plus the
+        prefix-index size — the first-class pool-state snapshot the
+        engine summary and the metrics gauges both read."""
+        return {
+            "pool_free_blocks": self.pool.free_blocks,
+            "pool_evictable_blocks": self.evictable_blocks,
+            "pool_referenced_blocks": self.referenced_blocks,
+            "prefix_index_entries": len(self._prefix),
+        }
+
     def alloc_blocks(self, n: int) -> Optional[List[int]]:
         """``pool.alloc`` with LRU prefix eviction as the backstop: pop
         index entries (oldest first) whose block only the index holds —

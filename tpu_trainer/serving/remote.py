@@ -546,6 +546,15 @@ class RemoteReplica:
         self._reqs.clear()
         return sorted(out, key=lambda r: (r.arrival_time, r.rid))
 
+    def metrics_snapshot(self) -> dict:
+        """Pull the worker engine's registry snapshot over the
+        ``metrics`` verb — plain JSON scalars, callbacks already
+        resolved worker-side. The front-end merges it label-wise
+        (``replica=N``) into its own registry. MAIN-thread only, like
+        every RPC here: the scrape thread must never touch the
+        socket."""
+        return self._rpc("metrics").get("metrics", {})
+
     def release(self) -> None:
         """Tear the worker down (graceful shutdown RPC when reachable,
         then reap the process). A deliberate release is marked retired

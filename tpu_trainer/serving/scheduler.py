@@ -176,8 +176,13 @@ class Scheduler:
         self._free_slots = list(range(cache.slots))
         self._last_was_prefill = False
         self.n_preemptions = 0
+        self.n_admissions = 0          # admission events (re-admits count)
         self.prefix_hit_tokens = 0
         self.prompt_tokens = 0         # prompt tokens over all admissions
+        # Terminal transitions by state, counted at the single funnel
+        # (retire + the waiting-queue branches of cancel/expire that
+        # bypass it). The metrics plane mirrors these monotone counts.
+        self.terminal_counts = {s: 0 for s in sorted(TERMINAL_STATES)}
         # Span-tracing hooks, wired by the owning engine: ``tracer`` is a
         # serving.tracing.SpanTracer, ``now_fn`` the engine clock. The
         # scheduler is the single funnel for admission / preemption /
@@ -297,6 +302,7 @@ class Scheduler:
             req._blocks_registered = matched // self.cache.block_size
             self.prefix_hit_tokens += matched
             self.prompt_tokens += len(req.prompt)
+            self.n_admissions += 1
             self.running.append(req)
             admitted.append(req)
             if req.admitted_at is None and self.now_fn is not None:
@@ -429,6 +435,7 @@ class Scheduler:
         assert status in TERMINAL_STATES, status
         self._vacate(req)
         req.status = status
+        self.terminal_counts[status] += 1
         self._emit(req, status, generated=len(req.generated))
 
     def cancel(self, rid: int, *, status: str = "cancelled"):
@@ -443,6 +450,7 @@ class Scheduler:
             if req.rid == rid:
                 self.waiting.remove(req)
                 req.status = status
+                self.terminal_counts[status] += 1
                 self._emit(req, status, generated=len(req.generated))
                 return req
         for req in self.running:
@@ -466,6 +474,7 @@ class Scheduler:
                     if r.deadline is not None and now > r.deadline]:
             self.waiting.remove(req)
             req.status = "deadline_exceeded"
+            self.terminal_counts["deadline_exceeded"] += 1
             self._emit(req, "deadline_exceeded",
                        generated=len(req.generated))
             expired.append(req)

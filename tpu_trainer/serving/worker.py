@@ -97,11 +97,17 @@ class WorkerServer:
                               bool(jax_cfg["threefry_partitionable"]))
 
         from tpu_trainer.models.config import GPTConfig
+        from tpu_trainer.obs.metrics import MetricsRegistry
         from tpu_trainer.serving.engine import ServingEngine
 
         params = load_params_npz(self.spec["params_npz"])
         config = GPTConfig(**self.spec["config"])
+        # Every worker engine gets a live registry: the front-end pulls
+        # snapshots over the ``metrics`` verb and merges them label-wise
+        # (replica=N) into its own registry. Single-threaded here — the
+        # reactor owns both the engine and the scrape.
         eng = ServingEngine(params, config, clock=lambda: self._now_value,
+                            registry=MetricsRegistry(),
                             **self.spec.get("engine", {}))
         eng._t0 = 0.0   # front-end clock domain: timestamps ARE its times
         return eng
@@ -203,6 +209,12 @@ class WorkerServer:
                     "load": self._load()}
         if method == "summary":
             return {"summary": _jsonable(self.engine.summary()),
+                    "load": self._load()}
+        if method == "metrics":
+            # Registry snapshot for the front-end merge: callbacks are
+            # resolved to plain values here, so the wire carries only
+            # JSON scalars (see obs.metrics.MetricsRegistry.snapshot).
+            return {"metrics": self.engine.registry.snapshot(),
                     "load": self._load()}
         if method == "reset":
             # Fresh engine, warm process: the jitted step is memoised per

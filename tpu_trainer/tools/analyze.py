@@ -1466,17 +1466,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             stall_recovery_tol=args.stall_recovery_tol,
             queue_wait_tol=args.queue_wait_tol)
 
+    exit_code = (1 if verdicts is not None
+                 and any(v["verdict"] == "FAIL" for v in verdicts) else 0)
     if args.json:
-        print(json.dumps({"report": report, "verdicts": verdicts}, indent=1))
+        # Machine-readable envelope for CI: the full report, the verdict
+        # list (each row carries metric / verdict / base / new and, when
+        # the gate evaluated, delta + tolerance), a PASS/FAIL/SKIP tally,
+        # and the exit code the process is about to return — so a caller
+        # parsing stdout never has to re-derive the gate decision.
+        gate = None
+        if verdicts is not None:
+            gate = {k: sum(1 for v in verdicts if v["verdict"] == k)
+                    for k in ("PASS", "FAIL", "SKIP")}
+        print(json.dumps({"report": report, "verdicts": verdicts,
+                          "gate": gate, "exit_code": exit_code}, indent=1))
     else:
         for line in render(report):
             print(line)
         if verdicts is not None:
             for line in render_verdicts(verdicts):
                 print(line)
-    if verdicts is not None and any(v["verdict"] == "FAIL" for v in verdicts):
-        return 1
-    return 0
+    return exit_code
 
 
 if __name__ == "__main__":

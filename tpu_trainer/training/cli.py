@@ -234,6 +234,10 @@ def build_parser(mode: str) -> argparse.ArgumentParser:
                         "on multi-process runs (each vote is a collective; "
                         "default 10). Single-process runs vote every step")
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve live /metrics + /healthz + /statusz on this "
+                        "port (0 = ephemeral; host 0 only; off by default "
+                        "— records and streams are identical either way)")
     p.add_argument("--wandb_project", type=str, default=None,
                    help="log metrics to Weights & Biases (import-guarded)")
     p.add_argument("--tensorboard_dir", type=str, default=None,
@@ -568,6 +572,7 @@ def resolve_configs(args, mode: str):
         "num_batches": _pick(args.num_batches, 100),
         "tokenizer": _pick(args.tokenizer, y_data.get("tokenizer"), "gpt2"),
         "metrics_jsonl": args.metrics_jsonl,
+        "metrics_port": args.metrics_port,
         "wandb_project": args.wandb_project,
         "tensorboard_dir": args.tensorboard_dir,
         "eval_batches": _pick(args.eval_batches, 8),
@@ -1129,6 +1134,27 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             if main:
                 print(f"flight recorder dump failed: {dump_err}", flush=True)
 
+    # Live metrics plane (ISSUE 18): registry + bridge + HTTP endpoint,
+    # host 0 only. The bridge rides the MetricLogger observer hook, so
+    # every record the sinks see also updates the scrapeable registry —
+    # and nothing else changes: with --metrics_port unset this whole
+    # block is skipped and the run is bit-identical.
+    metrics_server = None
+    metrics_bridge = None
+    if data_opts["metrics_port"] is not None and main:
+        from tpu_trainer.obs.http import MetricsServer
+        from tpu_trainer.obs.metrics import MetricsRegistry
+
+        metrics_bridge = telemetry_lib.MetricsBridge(MetricsRegistry())
+        metrics_server = MetricsServer(
+            metrics_bridge.registry, port=data_opts["metrics_port"],
+            statusz_fn=metrics_bridge.statusz)
+        # Ready once the run has produced its first record — before
+        # that the process is alive but still compiling/restoring.
+        metrics_server.health.add_probe(
+            "first_record", lambda: metrics_bridge.n_records > 0)
+        print(f"metrics: serving {metrics_server.url}/metrics", flush=True)
+
     logger = MetricLogger(
         model_config,
         tokens_per_step=trainer.tokens_per_step,
@@ -1143,6 +1169,7 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         },
         seq_len=training_config.max_seq_len,
         recorder=recorder,
+        observer=metrics_bridge,
     )
     logger.tokens_seen = tokens_seen
 
@@ -1188,6 +1215,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
             return 0
         finally:
             logger.close()
+            if metrics_server is not None:
+                metrics_server.close()
             if installed_plan is not None:
                 faults.clear()
 
@@ -1795,6 +1824,8 @@ def run_training(argv=None, mode: str = "ddp") -> int:
         signal.signal(signal.SIGTERM, old_handler)
         profiler.close()
         logger.close()
+        if metrics_server is not None:
+            metrics_server.close()
         if installed_plan is not None:
             faults.clear()
     if main:

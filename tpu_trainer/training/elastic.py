@@ -167,6 +167,7 @@ class Supervisor:
         drain_grace_s: float = 60.0,
         death_settle_s: float = 1.0,
         env: Optional[Dict[str, str]] = None,
+        metrics_port: Optional[int] = None,
     ):
         if num_processes < 1:
             raise ValueError("num_processes must be >= 1")
@@ -214,6 +215,58 @@ class Supervisor:
         self._standby_seq = 0
         self._last_promoted = 0
         self._refill_pending = False
+        # Live metrics plane (obs/): the supervisor serves its own
+        # endpoint — attempt/world/restart/grow/standby state as gauges
+        # and counters mirrored off the attributes above (set_function:
+        # read at scrape time, zero cost in the poll loop). The server
+        # starts in run() and dies with it; metrics_port=None means no
+        # registry work at all beyond no-op constructors.
+        self.metrics_port = metrics_port
+        self._metrics_server = None
+        self._install_metrics()
+
+    def _install_metrics(self) -> None:
+        from tpu_trainer.obs.metrics import NULL_REGISTRY, MetricsRegistry
+
+        self.registry = (MetricsRegistry() if self.metrics_port is not None
+                         else NULL_REGISTRY)
+        reg = self.registry
+        reg.gauge("elastic_attempt", "Current attempt number"
+                  ).set_function(lambda: self.attempt)
+        world = reg.gauge("elastic_world", "Host world size",
+                          labelnames=("kind",))
+        world.labels(kind="current").set_function(lambda: self.world)
+        world.labels(kind="desired").set_function(
+            lambda: self.desired_world)
+        reg.gauge("elastic_standbys", "Warm spares parked"
+                  ).set_function(lambda: len(self._standbys))
+        reg.counter("elastic_restarts_total", "Fault restarts"
+                    ).set_function(lambda: self.restarts)
+        reg.counter("elastic_grows_total", "World grow-backs"
+                    ).set_function(lambda: self.grows)
+        reg.counter("elastic_promotions_total", "Standby promotions"
+                    ).set_function(lambda: self.promoted_total)
+        reg.gauge("elastic_recovery_seconds_total",
+                  "Wall-clock spent in fault recovery").set_function(
+                      lambda: self.ledger.seconds("recovery"))
+        reg.gauge("elastic_grow_seconds_total",
+                  "Wall-clock spent in grow relaunches").set_function(
+                      lambda: self.ledger.seconds("grow"))
+
+    def statusz(self) -> dict:
+        return {
+            "kind": "elastic_supervisor",
+            "attempt": self.attempt,
+            "world": self.world,
+            "desired_world": self.desired_world,
+            "restarts": self.restarts,
+            "grows": self.grows,
+            "standbys": len(self._standbys),
+            "standby_promotions": self.promoted_total,
+            "allow_grow": self.allow_grow,
+            "max_restarts": self.max_restarts,
+            "run_dir": self.run_dir,
+        }
 
     # --- plumbing -------------------------------------------------------
 
@@ -494,6 +547,13 @@ class Supervisor:
 
     def run(self) -> int:
         pending: List[dict] = []  # reform windows awaiting the 1st new beat
+        if self.metrics_port is not None:
+            from tpu_trainer.obs.http import MetricsServer
+
+            self._metrics_server = MetricsServer(
+                self.registry, port=self.metrics_port,
+                statusz_fn=self.statusz)
+            self._log(f"metrics: serving {self._metrics_server.url}/metrics")
         # The pool is first filled by _launch AFTER attempt 0 is up: the
         # first attempt's ranks gain nothing from spares (everyone is
         # equally cold), but every reform after it does.
@@ -501,6 +561,9 @@ class Supervisor:
             return self._run_loop(pending)
         finally:
             self._retire_standbys()
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+                self._metrics_server = None
 
     def _run_loop(self, pending: List[dict]) -> int:
         while True:
@@ -758,6 +821,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--death_settle_s", type=float, default=1.0,
                    help="coalescing window after the first detected death "
                         "so same-interval co-deaths cost one restart")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve the supervisor's live /metrics + /healthz + "
+                        "/statusz (attempt/world/restart/grow/standby "
+                        "state) on this port; 0 = ephemeral")
     return p
 
 
@@ -786,6 +853,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         standby_hosts=args.standby_hosts,
         drain_grace_s=args.drain_grace_s,
         death_settle_s=args.death_settle_s,
+        metrics_port=args.metrics_port,
     )
     return sup.run()
 

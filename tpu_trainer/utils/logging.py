@@ -145,11 +145,17 @@ class MetricLogger:
         run_config: Optional[dict] = None,
         seq_len: Optional[int] = None,
         recorder=None,
+        observer=None,
     ):
         # Crash flight recorder (utils/flight_recorder.FlightRecorder):
         # every record emitted to the sinks is also observed by the ring
         # buffer, so a crash report carries the tail of the metrics stream.
         self._recorder = recorder
+        # Live-metrics observer (utils/telemetry.MetricsBridge): same
+        # observe(record) contract as the recorder, mapping records onto
+        # the obs registry a /metrics endpoint scrapes. Sink-side only —
+        # record contents are identical with or without one.
+        self._observer = observer
         self.model_config = model_config
         self.tokens_per_step = tokens_per_step
         # Sequence length the run trains at, for the MFU attention term;
@@ -274,6 +280,8 @@ class MetricLogger:
         }, prefix="train")
         if self._recorder is not None:
             self._recorder.observe(record)
+        if self._observer is not None:
+            self._observer.observe(record)
         return record
 
     def _emit_scalars(self, step: int, scalars: dict, prefix: str) -> None:
@@ -316,6 +324,8 @@ class MetricLogger:
         }, prefix="eval")
         if self._recorder is not None:
             self._recorder.observe(record)
+        if self._observer is not None:
+            self._observer.observe(record)
         return record
 
     def log_record(self, record: dict, stdout_lines=None) -> dict:
@@ -338,6 +348,8 @@ class MetricLogger:
             }, prefix=str(record.get("kind", "misc")))
         if self._recorder is not None:
             self._recorder.observe(record)
+        if self._observer is not None:
+            self._observer.observe(record)
         return record
 
     def close(self) -> None:

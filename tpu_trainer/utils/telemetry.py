@@ -518,3 +518,107 @@ class DeferredFetcher:
         if transform is not None:
             host = transform(host)
         return step, host
+
+
+class MetricsBridge:
+    """``MetricLogger`` observer that maps the training record stream
+    onto an obs registry (the live /metrics plane, ISSUE 18).
+
+    Attach via ``MetricLogger(observer=MetricsBridge(registry))`` — it
+    shares the flight recorder's ``observe(record)`` contract, so the
+    mapping from record fields to metrics lives in ONE place instead of
+    being sprinkled through the run loop. Everything is sink-side: the
+    records themselves (and therefore the JSONL stream) are identical
+    with or without a bridge attached.
+
+    Mapping: ``kind:"train"`` → step/loss/lr/tok-s/mfu gauges plus a
+    step-interval latency histogram (from ``elapsed_s`` deltas);
+    ``kind:"eval"`` → eval-loss gauge; ``kind:"goodput"`` → one gauge
+    per ``*_frac`` category; ``kind:"rollback"`` / ``"recompile"`` →
+    monotone counters. Unknown kinds count into
+    ``train_records_total{kind=...}`` and are otherwise ignored.
+    """
+
+    _GAUGE_FIELDS = (
+        ("loss", "train_loss", "Training loss (last logged step)"),
+        ("lr", "train_learning_rate", "Learning rate"),
+        ("grad_norm", "train_grad_norm", "Global gradient norm"),
+        ("tokens_per_sec", "train_tokens_per_sec", "Windowed tokens/s"),
+        ("effective_tokens_per_sec", "train_effective_tokens_per_sec",
+         "Windowed non-pad tokens/s"),
+        ("mfu", "train_mfu", "Model FLOPs utilization"),
+        ("peak_mem_gb", "train_peak_mem_gb", "Peak device memory (GB)"),
+    )
+
+    def __init__(self, registry):
+        self.registry = registry
+        self._step = registry.gauge("train_step", "Last logged step")
+        self._gauges = {
+            field: registry.gauge(name, help_)
+            for field, name, help_ in self._GAUGE_FIELDS}
+        self._tokens = registry.counter(
+            "train_tokens_total", "Tokens seen (cumulative)")
+        self._step_seconds = registry.histogram(
+            "train_step_seconds", "Wall-clock seconds per step "
+            "(log-interval deltas averaged over the interval)")
+        self._eval_loss = registry.gauge("train_eval_loss", "Held-out loss")
+        self._goodput = registry.gauge(
+            "train_goodput_frac", "Wall-clock fraction by category",
+            labelnames=("category",))
+        self._records = registry.counter(
+            "train_records_total", "Records observed by kind",
+            labelnames=("kind",))
+        self._rollbacks = registry.counter(
+            "train_rollbacks_total", "Checkpoint rollback-replay events")
+        self._recompiles = registry.counter(
+            "train_recompiles_total", "Train-step recompilations")
+        self._last_elapsed: Optional[Tuple[int, float]] = None
+        # Latest record per kind, for the /statusz human snapshot (the
+        # registry keeps history-free scalars; statusz wants the whole
+        # last record verbatim).
+        self.n_records = 0
+        self.last: dict = {}
+
+    def statusz(self) -> dict:
+        """/statusz payload: the last observed record of each kind."""
+        return {"kind": "training", "records_observed": self.n_records,
+                "last": dict(self.last)}
+
+    def observe(self, record: dict) -> None:
+        kind = str(record.get("kind", "train"))
+        self.n_records += 1
+        self.last[kind] = record
+        self._records.labels(kind=kind).inc()
+        if kind == "train":
+            self._observe_train(record)
+        elif kind == "eval" and "eval_loss" in record:
+            self._eval_loss.set(float(record["eval_loss"]))
+        elif kind == "goodput":
+            for key, val in record.items():
+                if key.endswith("_frac") and isinstance(val, (int, float)):
+                    self._goodput.labels(
+                        category=key[:-len("_frac")]).set(float(val))
+        elif kind == "rollback":
+            self._rollbacks.inc()
+        elif kind == "recompile":
+            self._recompiles.inc()
+
+    def _observe_train(self, record: dict) -> None:
+        step = record.get("step")
+        if step is not None:
+            self._step.set(float(step))
+        for field, gauge in self._gauges.items():
+            val = record.get(field)
+            if isinstance(val, (int, float)):
+                gauge.set(float(val))
+        seen = record.get("tokens_seen")
+        if isinstance(seen, (int, float)):
+            self._tokens.set_function(lambda s=float(seen): s)
+        elapsed = record.get("elapsed_s")
+        if step is not None and isinstance(elapsed, (int, float)):
+            if self._last_elapsed is not None:
+                d_step = int(step) - self._last_elapsed[0]
+                d_t = float(elapsed) - self._last_elapsed[1]
+                if d_step > 0 and d_t >= 0:
+                    self._step_seconds.observe(d_t / d_step)
+            self._last_elapsed = (int(step), float(elapsed))
