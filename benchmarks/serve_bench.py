@@ -217,6 +217,24 @@ def main(argv=None) -> int:
                         "single engine, 2*replicas+2 with --replicas — "
                         "more groups than replicas is what routing can "
                         "exploit)")
+    p.add_argument("--mesh-tensor", type=int, default=0,
+                   help="tensor-parallel mesh width per replica: shard the "
+                        "paged KV pool + attention heads over N devices "
+                        "(one replica = one mesh). Alone, runs the "
+                        "sharded-vs-single-device A/B (kind='serve' "
+                        "records stamped with tp / per-device pool blocks "
+                        "/ wire bytes per worker); with --workers, every "
+                        "worker process serves from its own N-device mesh "
+                        "with params shipped as 1/N shards. On CPU use "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count"
+                        "=8 to fake the devices")
+    p.add_argument("--device-block-budget", type=int, default=0,
+                   help="with --mesh-tensor: KV pool blocks per DEVICE "
+                        "(total pool = budget x shard factor; 0 = size "
+                        "the total pool to the workload's concurrent "
+                        "working set, so one device's budget is ~1/N of "
+                        "what the trace needs — the capacity case "
+                        "sharding exists for)")
     p.add_argument("--replicas", type=int, default=0,
                    help="run the multi-replica front-end with N engine "
                         "replicas instead of one engine (0 = single "
@@ -339,6 +357,10 @@ def main(argv=None) -> int:
         args.vocab, args.max_seq_len = 256, 64
         args.prompt_len, args.max_new = "4,12", 8
         args.block_size = 8
+        if args.mesh_tensor > 1:
+            # Head-sharded lanes need heads % tp == 0; the 2-head smoke
+            # model can only split 2 ways, so grow it just enough.
+            args.heads = max(4, args.mesh_tensor)
         if args.replicas > 0:
             # Multi-replica smoke needs prompts long enough to hold full
             # shared blocks, else no prefix key exists and the routing
@@ -354,11 +376,23 @@ def main(argv=None) -> int:
     if args.ab:
         args.no_baseline = True
 
+    if args.mesh_tensor > 1:
+        if args.heads % args.mesh_tensor:
+            p.error(f"--mesh-tensor {args.mesh_tensor} must divide "
+                    f"--heads {args.heads} (head-sharded decode)")
+        if args.spec == "draft":
+            p.error("--mesh-tensor composes with --spec ngram, not draft")
+
     import json
 
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if args.mesh_tensor > 1 and len(jax.devices()) < args.mesh_tensor:
+        p.error(f"--mesh-tensor {args.mesh_tensor} needs that many "
+                f"devices; found {len(jax.devices())} (on CPU, set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count=8)")
 
     from tpu_trainer.models.config import GPTConfig
     from tpu_trainer.models.gpt import GPT, generate_kv
@@ -497,6 +531,8 @@ def main(argv=None) -> int:
 
     if args.replicas > 0:
         return _run_frontend_lanes(args, params, cfg, make_trace, workload)
+    if args.mesh_tensor > 1:
+        return _run_mesh_lanes(args, params, cfg, make_trace, workload)
 
     draft_params = draft_config = None
     if args.spec == "draft":
@@ -885,6 +921,285 @@ class _MetricsScraper:
         self._thread.join(timeout=10.0)
 
 
+def _mesh_pool_geometry(args, cfg, tp):
+    """(device_budget, total_blocks, shard_factor) for the mesh lanes.
+
+    The default budget sizes the TOTAL pool to the workload's concurrent
+    working set — ``concurrency`` requests at the trace's longest
+    prompt+decode — so one device's budget is ~1/factor of what the
+    trace needs: the single-device twin only fits the workload because
+    it is granted the whole fleet's blocks (the A/B stays block-for-
+    block identical), while a real single device would be ``budget``
+    blocks short. That is the capacity case sharding exists for, and
+    ``peak_pool_blocks > device_pool_blocks`` in the record proves the
+    row exercised it."""
+    from tpu_trainer.serving.sharding import shard_factor
+
+    factor = shard_factor(cfg.kv_heads, tp)
+    if args.device_block_budget > 0:
+        budget = args.device_block_budget
+    else:
+        plo, phi = (int(x) for x in args.prompt_len.split(","))
+        per_req = -(-(phi + args.max_new) // args.block_size)
+        budget = -(-(args.concurrency * per_req + 2) // factor)
+    return budget, budget * factor, factor
+
+
+def _run_mesh_lanes(args, params, cfg, make_trace, workload) -> int:
+    """Sharded-decode lanes (``--mesh-tensor N`` without ``--workers``):
+    the same trace through (A) a single-device engine granted the whole
+    fleet's block budget and (B) a tensor-parallel engine whose KV pool
+    is head-sharded over N devices at ``--device-block-budget`` blocks
+    each — same total pool, same scheduling, so greedy streams must be
+    token-identical (``tp_token_match``, a gate). A third leg replays
+    the trace through a real cross-process worker whose params arrived
+    as 1/N host shards (``WorkerSupervisor(param_shard_world=N)``),
+    stamping ``wire_bytes_per_worker`` / ``wire_ratio`` (gated to
+    ~full/N) and ``shard_stream_token_match`` on the sharded record."""
+    import json
+
+    import numpy as np
+
+    from tpu_trainer.serving.engine import ServingEngine, request_metrics
+    from tpu_trainer.serving.frontend import ServingFrontend
+    from tpu_trainer.serving.remote import WorkerSupervisor
+    from tpu_trainer.serving.tracing import span_record
+    from tpu_trainer.utils.logging import SCHEMA_VERSION
+
+    tp = args.mesh_tensor
+    budget, total_blocks, factor = _mesh_pool_geometry(args, cfg, tp)
+    obs_records = []
+
+    def run_lane(lane, **kw):
+        engine = ServingEngine(
+            params, cfg, max_batch=args.concurrency,
+            block_size=args.block_size, kv_int8=args.kv_int8,
+            attention=args.attention,
+            prefill_chunk_tokens=args.prefill_chunk or None,
+            prefix_cache=args.prefix_cache,
+            spec=args.spec, spec_k=args.spec_k,
+            trace=not args.no_trace, **kw)
+        engine.run(make_trace())      # warm-up: compiles every step shape
+        engine.reset_stats()
+        finished = engine.run(make_trace())
+        s = engine.summary()
+        lat = request_metrics(finished)
+        drained = all(len(r.generated) >= min(r.max_new_tokens, 1)
+                      for r in finished)
+        record = {
+            "kind": "serve",
+            "schema_version": SCHEMA_VERSION,
+            "workload": workload,
+            "lane": lane,
+            "n_requests": len(finished),
+            "concurrency": args.concurrency,
+            "block_size": args.block_size,
+            "kv_int8": bool(args.kv_int8),
+            "prefill_chunk": int(args.prefill_chunk),
+            "prefix_cache": bool(args.prefix_cache),
+            "model": {"hidden": args.hidden, "layers": args.layers,
+                      "heads": args.heads, "vocab": args.vocab},
+            "tokens_per_s": round(s["tokens_per_s"], 2),
+            "generated_tokens": int(s["generated_tokens"]),
+            "wall_s": round(s["wall_s"], 4),
+            "occupancy_mean": round(s["occupancy_mean"], 4),
+            "occupancy_max": round(s["occupancy_max"], 4),
+            "preemptions": int(s["preemptions"]),
+            "prefix_hit_rate": round(s["prefix_hit_rate"], 4),
+            # Sharded-pool geometry (scheduler.pool_shard_stats): the
+            # scheduler budgets blocks PER SHARD — every device holds
+            # device_pool_blocks; head-sharding leaves block indices
+            # meaningful fleet-wide, so tables/lengths stay replicated.
+            "tp": int(s["tp"]),
+            "device_pool_blocks": int(s["device_pool_blocks"]),
+            "total_pool_blocks": int(s["total_pool_blocks"]),
+            "peak_pool_blocks": int(round(
+                s["occupancy_max"] * s["total_pool_blocks"])),
+        }
+        record["exceeds_device_budget"] = bool(
+            record["peak_pool_blocks"] > budget)
+        for name, series in lat.items():
+            if series:
+                record[f"{name}_p50_s"] = round(
+                    float(np.percentile(series, 50)), 5)
+                record[f"{name}_p99_s"] = round(
+                    float(np.percentile(series, 99)), 5)
+        if engine.tracer.enabled:
+            record["span_events"] = len(engine.tracer)
+            record["span_conservation_ok"] = bool(
+                engine.tracer.conservation()["ok"])
+            for rid in engine.tracer.rids():
+                obs_records.append(span_record(
+                    rid, engine.tracer.events(rid), lane=lane))
+        streams = {r.rid: list(r.generated) for r in finished}
+        return record, drained, streams
+
+    failures = []
+    rec_a, drained_a, streams_a = run_lane(
+        "single", num_blocks=total_blocks)
+    rec_b, drained_b, streams_b = run_lane(
+        f"sharded_tp{tp}", mesh_tensor=tp, device_block_budget=budget)
+    rec_b["tp_token_match"] = bool(streams_b == streams_a)
+    rec_b["tok_s_vs_single"] = round(
+        rec_b["tokens_per_s"] / max(rec_a["tokens_per_s"], 1e-9), 3)
+    if not (drained_a and drained_b):
+        failures.append("mesh lane did not drain")
+    if not rec_b["tp_token_match"]:
+        failures.append(
+            f"sharded tp={tp} greedy streams diverge from single-device")
+
+    # Shard-streaming leg: a REAL worker process builds the same tp
+    # engine from 1/N param shards (two-phase host_shards layout) —
+    # what actually crosses the wire to each host of a tp fleet.
+    sup = WorkerSupervisor(
+        params, cfg,
+        engine_kwargs=dict(
+            max_batch=args.concurrency, block_size=args.block_size,
+            kv_int8=args.kv_int8, attention=args.attention,
+            prefill_chunk_tokens=args.prefill_chunk or None,
+            prefix_cache=args.prefix_cache,
+            spec=args.spec, spec_k=args.spec_k,
+            mesh_tensor=tp, device_block_budget=budget,
+            trace=not args.no_trace),
+        param_shard_world=tp,
+        device_sets=[list(range(tp))])
+    try:
+        fe = ServingFrontend(
+            params, cfg, replicas=1, routing="affinity", seed=args.seed,
+            replica_factory=sup, trace=not args.no_trace)
+        fin = fe.run(make_trace())
+        worker_streams = {r.rid: list(r.generated) for r in fin}
+    finally:
+        sup.close()
+    per_worker = max(sup.param_shard_bytes)
+    rec_b["wire_bytes_per_worker"] = int(per_worker)
+    rec_b["param_bytes_full"] = int(sup.param_bytes_full)
+    rec_b["wire_ratio"] = round(
+        per_worker * tp / max(sup.param_bytes_full, 1), 3)
+    rec_b["shard_stream_token_match"] = bool(worker_streams == streams_b)
+    # npz per-shard framing adds a little; anything near 1/tp of the
+    # full tree per worker is "shipped as shards", 1.0x means it was
+    # not sharded at all.
+    if not 0.5 <= rec_b["wire_ratio"] <= 1.25:
+        failures.append(
+            f"wire bytes/worker {per_worker} x tp {tp} is "
+            f"{rec_b['wire_ratio']}x the full tree "
+            f"({sup.param_bytes_full}) — params were not shard-streamed")
+    if not rec_b["shard_stream_token_match"]:
+        failures.append(
+            "shard-streamed worker streams diverge from the in-process "
+            "sharded engine")
+
+    records = [rec_a, rec_b]
+    for rec in records:
+        _print_record_mesh(rec)
+        print(json.dumps(rec), flush=True)
+    print(f"A/B     sharded_tp{tp} vs single: tok/s "
+          f"x{rec_b['tok_s_vs_single']:.2f}, token match "
+          f"{rec_b['tp_token_match']}, wire/worker "
+          f"{rec_b['wire_bytes_per_worker']} B "
+          f"({rec_b['wire_ratio']:.2f}x full/tp)", flush=True)
+    if args.update_md:
+        update_mesh_md(workload, records, args)
+
+    for rec in records:
+        if rec.get("span_conservation_ok") is False:
+            failures.append(
+                f"span conservation broken in lane {rec['lane']}")
+    if args.ttft_p99_gate > 0:
+        p99 = rec_b.get("ttft_p99_s")
+        if p99 is None or p99 > args.ttft_p99_gate:
+            failures.append(
+                f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
+
+    if args.out:
+        with open(args.out, "a") as fh:
+            for rec in records + obs_records:
+                fh.write(json.dumps(rec) + "\n")
+        _analyze_out(args.out)
+    for f in failures:
+        print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
+    return 1 if failures else 0
+
+
+def _print_record_mesh(r) -> None:
+    print(f"{r['lane']:<12}{r['tokens_per_s']:10.1f} tok/s, tp={r['tp']} "
+          f"pool {r['device_pool_blocks']} blocks/device x{r['tp']} = "
+          f"{r['total_pool_blocks']} total (peak {r['peak_pool_blocks']}"
+          f"{', exceeds one device' if r['exceeds_device_budget'] else ''})"
+          f", {r['preemptions']} preemptions", flush=True)
+    if "ttft_p99_s" in r:
+        print(f"TTFT    p50 {r['ttft_p50_s'] * 1e3:8.1f} ms   "
+              f"p99 {r['ttft_p99_s'] * 1e3:8.1f} ms", flush=True)
+    if r.get("wire_bytes_per_worker") is not None:
+        print(f"wire    {r['wire_bytes_per_worker']} B/worker shard vs "
+              f"{r['param_bytes_full']} B full tree "
+              f"({r['wire_ratio']:.2f}x full/tp), worker stream match "
+              f"{r['shard_stream_token_match']}", flush=True)
+
+
+def update_mesh_md(workload, records, args) -> None:
+    """Splice the sharded-decode A/B table into benchmarks/results.md
+    (marker block ``serving-mesh``, its own section)."""
+    start = "<!-- serving-mesh:start -->"
+    end = "<!-- serving-mesh:end -->"
+    m = records[0]["model"]
+    tp = max(r["tp"] for r in records)
+    header = (
+        f"`XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+        f"python benchmarks/serve_bench.py --workload {workload} "
+        f"--mesh-tensor {tp}` — hidden {m['hidden']}, layers "
+        f"{m['layers']}, heads {m['heads']}, "
+        f"{records[0]['n_requests']} reqs @ concurrency "
+        f"{records[0]['concurrency']}, block {records[0]['block_size']} "
+        f"({time.strftime('%Y-%m-%d')}). Both lanes hold the same total "
+        f"pool; the sharded lane spreads it over {tp} devices, so a "
+        f"peak past the per-device budget is served only by the mesh. "
+        f"Wire/worker is the measured host-shard npz each worker of a "
+        f"tp={tp} fleet downloads vs the full tree.\n\n"
+    )
+    lines = [
+        "| Lane | tp | blocks/device | total | peak | tok/s "
+        "| TTFT p99 (ms) | token match | wire/worker |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        wire = "-"
+        if r.get("wire_bytes_per_worker") is not None:
+            wire = (f"{r['wire_bytes_per_worker'] / 1024:.0f} KiB "
+                    f"({r['wire_ratio']:.2f}x full/tp)")
+        peak = str(r["peak_pool_blocks"])
+        if r["exceeds_device_budget"]:
+            peak += " (> device)"
+        match = ("bit-exact" if r.get("tp_token_match")
+                 else "-" if r.get("tp_token_match") is None else "DIVERGED")
+        lines.append(
+            f"| {r['lane']} | {r['tp']} | {r['device_pool_blocks']} "
+            f"| {r['total_pool_blocks']} | {peak} "
+            f"| {r['tokens_per_s']:,.0f} "
+            f"| {(r.get('ttft_p99_s') or 0) * 1e3:.1f} "
+            f"| {match} | {wire} |"
+        )
+    block = f"{start}\n{header}" + "\n".join(lines) + f"\n{end}"
+    section_head = "## Sharded decode"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if start in text:
+        text = text.split(start)[0] + block + text.split(end)[1]
+    elif section_head in text:
+        text = text.replace(f"{section_head}\n",
+                            f"{section_head}\n\n{block}\n", 1)
+    elif "\n## Multi-replica serving" in text:
+        text = text.replace(
+            "\n## Multi-replica serving",
+            f"\n{section_head}\n\n{block}\n\n## Multi-replica serving", 1)
+    else:
+        text += f"\n{section_head}\n\n{block}\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote sharded-decode table to {_RESULTS_MD}", file=sys.stderr)
+
+
 def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     """Multi-replica lanes (``--replicas N``): the same trace through the
     serving front-end, one lane per routing policy (``--ab``: random vs
@@ -926,6 +1241,22 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         prefill_chunk_tokens=args.prefill_chunk or None,
         prefix_cache=True,
     )
+    # Mesh-aware fleet: every replica serves from its own tp-device
+    # mesh, replicas tiling the host's devices into disjoint sets.
+    # Engine kwargs stay scalar-only (they cross the worker wire);
+    # device sets travel separately — top-level spec key for workers,
+    # replica_device_sets for in-process replicas.
+    tp = getattr(args, "mesh_tensor", 0) or 0
+    mesh_dsets = None
+    if tp > 1:
+        import jax
+
+        budget, _, factor = _mesh_pool_geometry(args, cfg, tp)
+        engine_kwargs.update(mesh_tensor=tp, num_blocks=None,
+                             device_block_budget=budget)
+        n_sets = max(1, len(jax.devices()) // tp)
+        mesh_dsets = [[i * tp + j for j in range(tp)]
+                      for i in range(n_sets)]
     supervisors = []
 
     def make_supervisor():
@@ -934,6 +1265,11 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         sup_kwargs = {}
         if args.rpc_timeout > 0:
             sup_kwargs["rpc_timeout_s"] = args.rpc_timeout
+        if tp > 1:
+            # Shard-streaming launch: each worker's params arrive as a
+            # 1/tp host-shard npz, and each worker owns one device set.
+            sup_kwargs["param_shard_world"] = tp
+            sup_kwargs["device_sets"] = mesh_dsets
         # Worker processes build their engines from this spec, so the
         # tracing switch must travel with it for the fleet to agree.
         sup = WorkerSupervisor(
@@ -950,6 +1286,7 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             max_queue_depth=args.max_queue or max(args.requests, 1),
             wait_watermark=args.wait_watermark or None,
             seed=args.seed, replica_factory=sup,
+            replica_device_sets=(mesh_dsets if sup is None else None),
             trace=not args.no_trace, incident_dir=incident_dir,
             registry=registry,
             **engine_kwargs,
@@ -978,6 +1315,7 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         if args.metrics_port is not None:
             from tpu_trainer.obs.metrics import MetricsRegistry
             registry = MetricsRegistry()
+        sup = None
         if transport == "rpc":
             # Warm-up compiles inside the worker PROCESSES, so they must
             # survive into the timed run: reset() rebuilds each worker's
@@ -1142,9 +1480,27 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             record["metrics_scrapes"] = len(scraper.latencies)
             record["metrics_scrape_max_s"] = round(max_lat, 4)
             mserver.close()
+        if tp > 1:
+            record["tp"] = tp
+            record["device_pool_blocks"] = int(budget)
+            record["total_pool_blocks"] = int(budget * factor)
+            if transport == "rpc" and sup is not None \
+                    and sup.param_shard_bytes:
+                # What each worker of this fleet pulled over the wire:
+                # its 1/tp host-shard npz, vs the full logical tree.
+                per_worker = max(sup.param_shard_bytes)
+                record["wire_bytes_per_worker"] = int(per_worker)
+                record["param_bytes_full"] = int(sup.param_bytes_full)
+                record["wire_ratio"] = round(
+                    per_worker * tp / max(sup.param_bytes_full, 1), 3)
+                if not 0.5 <= record["wire_ratio"] <= 1.25:
+                    metrics_failures.append(
+                        f"lane {lane}: wire ratio {record['wire_ratio']} "
+                        f"— params were not shard-streamed (~1/tp each)")
         ttfts = {r.rid: r.first_token_at - r.arrival_time
                  for r in finished if r.first_token_at is not None}
-        return record, drained, ttfts
+        streams = {r.rid: list(r.generated) for r in finished}
+        return record, drained, ttfts, streams
 
     workers_mode = args.workers > 0
     if workers_mode:
@@ -1170,16 +1526,42 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         lanes.append(("replica_kill", args.routing,
                       f"replica_kill@{args.replica_kill}", "inproc"))
 
-    records, all_drained, lane_ttfts = [], True, {}
+    records, all_drained, lane_ttfts, lane_streams = [], True, {}, {}
     try:
         for lane, routing, spec, transport in lanes:
-            rec, drained, ttfts = run_lane(lane, routing, spec, transport)
+            rec, drained, ttfts, streams = run_lane(
+                lane, routing, spec, transport)
             all_drained = all_drained and drained
             records.append(rec)
             lane_ttfts[lane] = ttfts
+            lane_streams[lane] = streams
     finally:
         for sup in supervisors:
             sup.close()
+
+    tp_failures = []
+    if tp > 1 and records:
+        # Sharded parity across lanes: sampling is (seed, token_index)-
+        # keyed and scheduling is shared, so every lane of the same
+        # trace — including the fault drills, whose failover preserves
+        # stream identity — must agree token-for-token on every request
+        # both lanes finished. Divergence means the sharded compute
+        # path leaked into the tokens.
+        base_lane = records[0]["lane"]
+        for rec in records:
+            if rec["lane"] == "rpc":
+                base_lane = "rpc"      # the no-fault cross-process lane
+        base = lane_streams[base_lane]
+        for rec in records:
+            if rec["lane"] == base_lane:
+                continue
+            s = lane_streams[rec["lane"]]
+            rec["tp_token_match"] = all(
+                base[rid] == gen for rid, gen in s.items() if rid in base)
+            if not rec["tp_token_match"]:
+                tp_failures.append(
+                    f"lane {rec['lane']}: sharded streams diverge from "
+                    f"lane {base_lane}")
 
     if workers_mode and args.ab and len(records) >= 2:
         a = next(r for r in records if r["transport"] == "inproc")
@@ -1248,6 +1630,7 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         if p99 is None or p99 > args.ttft_p99_gate:
             failures.append(
                 f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
+    failures.extend(tp_failures)
     failures.extend(metrics_failures)
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)

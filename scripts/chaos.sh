@@ -200,4 +200,23 @@ python benchmarks/serve_bench.py --smoke --workload shared_prefix \
 python -m tpu_trainer.tools.analyze "$OBS_OUT" \
   --compare "$OBS_OUT" --reject-tol 0.0 --queue-wait-tol 60.0
 
+# 13. Sharded decode under fire: every worker serves from its own
+#     2-device tensor-parallel mesh (8 fake CPU devices), params shipped
+#     as 1/tp host shards, and one sharded worker is SIGKILL'd mid-run.
+#     The bench gates stream identity itself (worker_kill lane vs the
+#     undisturbed rpc lane must be token-identical — failover over a
+#     sharded replica preserves bit-exactness) and the shard-streaming
+#     wire ratio (~full/tp per worker); analyze then re-gates parity
+#     categorically (--tp-parity-tol 0.0: one diverged lane fails) plus
+#     the usual conservation/reject/queue-wait budgets.
+TP_OUT="$OUT/sharded_kill.jsonl"
+rm -f "$TP_OUT"
+echo "== chaos: sharded_kill (tensor-parallel worker failover) =="
+XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --mesh-tensor 2 --workers 2 --ab --worker-kill 6 --out "$TP_OUT"
+python -m tpu_trainer.tools.analyze "$TP_OUT" \
+  --compare "$TP_OUT" --tp-parity-tol 0.0 --reject-tol 0.0 \
+  --rpc-overhead-tol 5.0 --queue-wait-tol 60.0
+
 echo "chaos: full matrix clean ($OUT)"

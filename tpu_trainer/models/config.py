@@ -18,7 +18,7 @@ by design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -224,6 +224,21 @@ class GPTConfig:
     # dataclasses.replace; never a user knob.
     paged_hist_blocks: int = 0
 
+    # Tensor-parallel decode: shard the paged engine's dispatch over a
+    # single-axis ("tp",) device mesh — Q heads split paged_tp ways, KV
+    # pools shard on their kv-heads axis when divisible (else replicate:
+    # the GQA kv_heads < tp mode), params commit sharded and gather to
+    # replicated inside the step (serving/sharding.py). Set by
+    # ServingEngine via dataclasses.replace from its mesh_tensor /
+    # mesh_devices kwargs — never a user-facing model knob. Because the
+    # jitted-step memos key on the (hashable) config, carrying the tp
+    # degree AND the device-id tuple here is what keeps two engines with
+    # otherwise-equal configs but different meshes from sharing one jit
+    # (the latent wrong-device-dispatch bug at tp=1 too: an explicit
+    # device set at tp=1 still changes the key).
+    paged_tp: int = 1
+    paged_tp_devices: Optional[Tuple[int, ...]] = None
+
     # Static switch for the ragged (per-row prompt length) KV-decode path:
     # set internally by generate_kv(prompt_lens=...); uniform decode keeps
     # the cheaper shared-position attention. Not a training knob.
@@ -315,6 +330,18 @@ class GPTConfig:
                     f"paged_hist_blocks ({self.paged_hist_blocks}) must be "
                     f"in [0, paged_max_blocks={self.paged_max_blocks}]"
                 )
+        # TP decode feasibility + hashability: the devices tuple may
+        # arrive as a JSON list (worker specs round-trip the config dict);
+        # coerce so the frozen config stays a valid static jit argument.
+        if self.paged_tp_devices is not None and not isinstance(
+                self.paged_tp_devices, tuple):
+            object.__setattr__(
+                self, "paged_tp_devices",
+                tuple(int(d) for d in self.paged_tp_devices))
+        if self.paged_tp != 1:
+            from tpu_trainer.serving.sharding import validate_tp
+
+            validate_tp(self.num_heads, self.kv_heads, self.paged_tp)
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"unknown remat_policy {self.remat_policy!r}; "

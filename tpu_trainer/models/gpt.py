@@ -406,6 +406,16 @@ class CausalSelfAttention(nn.Module):
           + 1`` pooled positions (flash_decode kernel or the jnp
           reference, ``cfg.paged_attention``); ``lengths`` increments.
           ``offsets`` is ignored (broadcast as zeros).
+
+        Tensor parallel (``cfg.paged_tp > 1``): both branches run the
+        identical per-head math under a head-sharded ``shard_map`` over
+        the replica's mesh (serving/sharding.py) — decode via
+        ``ops.flash.paged_attention_sharded``, prefill via the local
+        ``attend`` closure — closing with an exact disjoint-slice
+        all-reduce, so sharded greedy streams stay token-identical to
+        the single-device engine. Tables/lengths/offsets remain
+        replicated host mirrors; only the pools (when ``kvh % tp == 0``)
+        and the heads axis of activations split.
         """
         cfg = self.config
         b, s, h, d = q.shape
@@ -501,17 +511,8 @@ class CausalSelfAttention(nn.Module):
 
                 kf, vf = repeat_kv(kf, vf, h)
             scale = 1.0 / (d ** 0.5)
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * scale
-            q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
-            k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
-            chunk_len = (lengths - offsets)[:, None, None]
-            allowed = (k_pos[None] <= q_pos[None]) & (
-                (k_pos[None] < chunk_len)
-                | (k_pos[None] == q_pos[None])
-            )
-            scores = jnp.where(
-                allowed[:, None], scores, jnp.finfo(scores.dtype).min)
             hb = cfg.paged_hist_blocks
+            hk = hv = None
             if hb > 0:
                 # Non-zero-offset chunk: also attend the pooled history
                 # (earlier chunks / shared prefix) — the first hb table
@@ -536,21 +537,75 @@ class CausalSelfAttention(nn.Module):
                     from tpu_trainer.ops.attention import repeat_kv
 
                     hk, hv = repeat_kv(hk, hv, h)
-                h_scores = jnp.einsum("bqhd,bkhd->bhqk", q, hk) * scale
-                h_pos = jax.lax.broadcasted_iota(
-                    jnp.int32, (b, hb * bsz), 1)
-                h_allowed = h_pos < offsets[:, None]        # [b, hb*bsz]
-                h_scores = jnp.where(
-                    h_allowed[:, None, None], h_scores,
-                    jnp.finfo(h_scores.dtype).min)
-                # History keys come FIRST: ascending global position,
-                # the same reduce order as the monolithic pass — the
-                # bit-exactness contract of chunked prefill.
-                scores = jnp.concatenate([h_scores, scores], axis=-1)
-                vf = jnp.concatenate([hv, vf], axis=1)
-            weights = jax.nn.softmax(
-                scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", weights, vf)
+
+            # In-flight (+ optional pooled-history) attention over FULL
+            # q-head inputs. Extracted as a closure so the tensor-parallel
+            # path can run the identical math per head shard under
+            # shard_map: softmax reduces over keys only, so splitting the
+            # heads axis changes no arithmetic, and kf/vf/hk/hv are
+            # repeated to q heads BEFORE sharding so GQA needs no special
+            # casing here (repeat-then-shard).
+            def attend(q_a, kf_a, vf_a, ln_a, of_a, *hist):
+                scores = jnp.einsum("bqhd,bkhd->bhqk", q_a, kf_a) * scale
+                q_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+                k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+                chunk_len = (ln_a - of_a)[:, None, None]
+                allowed = (k_pos[None] <= q_pos[None]) & (
+                    (k_pos[None] < chunk_len)
+                    | (k_pos[None] == q_pos[None])
+                )
+                scores = jnp.where(
+                    allowed[:, None], scores, jnp.finfo(scores.dtype).min)
+                v_cat = vf_a
+                if hist:
+                    hk_a, hv_a = hist
+                    h_scores = jnp.einsum(
+                        "bqhd,bkhd->bhqk", q_a, hk_a) * scale
+                    h_pos = jax.lax.broadcasted_iota(
+                        jnp.int32, (b, hb * bsz), 1)
+                    h_allowed = h_pos < of_a[:, None]       # [b, hb*bsz]
+                    h_scores = jnp.where(
+                        h_allowed[:, None, None], h_scores,
+                        jnp.finfo(h_scores.dtype).min)
+                    # History keys come FIRST: ascending global position,
+                    # the same reduce order as the monolithic pass — the
+                    # bit-exactness contract of chunked prefill.
+                    scores = jnp.concatenate([h_scores, scores], axis=-1)
+                    v_cat = jnp.concatenate([hv_a, vf_a], axis=1)
+                weights = jax.nn.softmax(
+                    scores.astype(jnp.float32), axis=-1).astype(q_a.dtype)
+                return jnp.einsum("bhqk,bkhd->bqhd", weights, v_cat)
+
+            hist = () if hk is None else (hk, hv)
+            tp = cfg.paged_tp
+            if tp > 1:
+                from jax.sharding import PartitionSpec as P
+
+                from tpu_trainer.serving import sharding as tp_lib
+                from tpu_trainer.utils.jax_compat import shard_map
+
+                mesh = tp_lib.tp_mesh(tp, cfg.paged_tp_devices)
+                hl = h // tp
+                head = P(None, None, tp_lib.TP_AXIS, None)
+                in_specs = [head, head, head, P(), P()]
+                in_specs += [head] * len(hist)
+
+                def body(q_l, kf_l, vf_l, ln_l, of_l, *hist_l):
+                    i = jax.lax.axis_index(tp_lib.TP_AXIS)
+                    out_l = attend(q_l, kf_l, vf_l, ln_l, of_l, *hist_l)
+                    # Disjoint head slices: the psum is an exact concat
+                    # (one non-zero contributor per element).
+                    full = jnp.zeros((b, s, h, d), out_l.dtype)
+                    full = jax.lax.dynamic_update_slice(
+                        full, out_l, (0, 0, i * hl, 0))
+                    return jax.lax.psum(full, tp_lib.TP_AXIS)
+
+                out = shard_map(
+                    body, mesh=mesh, in_specs=tuple(in_specs),
+                    out_specs=P(), check_vma=False,
+                )(q, kf, vf, lengths, offsets, *hist)
+            else:
+                out = attend(q, kf, vf, lengths, offsets, *hist)
             new_len = lengths
         else:
             from tpu_trainer.ops import flash as flash_lib
@@ -560,12 +615,21 @@ class CausalSelfAttention(nn.Module):
             if impl == "auto":
                 impl = ("kernel" if jax.default_backend() == "tpu"
                         else "reference")
-            fn = (flash_lib.flash_decode if impl == "kernel"
-                  else flash_lib.paged_attention_reference)
-            out = fn(
-                q[:, 0], pool_k, pool_v, tables, new_len,
-                k_scale=scale_k, v_scale=scale_v,
-            ).astype(q.dtype)[:, None]                    # [b, 1, h, d]
+            if cfg.paged_tp > 1:
+                from tpu_trainer.serving import sharding as tp_lib
+
+                out = flash_lib.paged_attention_sharded(
+                    q[:, 0], pool_k, pool_v, tables, new_len,
+                    mesh=tp_lib.tp_mesh(cfg.paged_tp, cfg.paged_tp_devices),
+                    k_scale=scale_k, v_scale=scale_v, impl=impl,
+                ).astype(q.dtype)[:, None]                # [b, 1, h, d]
+            else:
+                fn = (flash_lib.flash_decode if impl == "kernel"
+                      else flash_lib.paged_attention_reference)
+                out = fn(
+                    q[:, 0], pool_k, pool_v, tables, new_len,
+                    k_scale=scale_k, v_scale=scale_v,
+                ).astype(q.dtype)[:, None]                # [b, 1, h, d]
 
         if not self.is_initializing():
             pk.value = pool_k

@@ -1787,3 +1787,88 @@ def paged_attention_reference(
     s = jnp.where(pos < lengths[:, None, None], s, _NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhk,bkhd->bhd", w, v)
+
+
+def paged_attention_sharded(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    tables: jax.Array,
+    lengths: jax.Array,
+    *,
+    mesh,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Tensor-parallel paged decode dispatch: ``flash_decode`` (or the
+    reference) under a full-manual ``shard_map`` over a single-axis
+    device mesh, Q heads split along the mesh axis.
+
+    Two KV layouts, matching the pool placement the serving engine
+    commits (serving/sharding.py):
+
+    - ``kv_heads % tp == 0``: pools arrive sharded on their kv-heads
+      axis; each device runs the stock kernel on its ``h/tp`` Q heads x
+      ``kvh/tp`` kv heads slice (the per-device GQA group size is
+      unchanged, so the kernel's ``ih // group`` indexing needs no
+      adjustment).
+    - ``tp % kv_heads == 0`` (GQA, kv_heads < tp): pools arrive
+      replicated; each device's contiguous Q-head slice falls inside ONE
+      kv group, so the body slices kv head ``axis_index // (tp // kvh)``
+      and runs the kernel with a single kv head.
+
+    Either way each device owns a disjoint contiguous slice of the
+    output's heads axis; the final ``psum`` all-reduce of zero-padded
+    slices is therefore an exact concatenation (every output element has
+    exactly one non-zero contributor — no floating-point reassociation),
+    which is what keeps the sharded engine bit-identical to the
+    single-device one. Returns f32 ``[batch, heads, head_dim]``, same
+    contract as ``flash_decode``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_trainer.utils.jax_compat import shard_map
+
+    if impl == "auto":
+        impl = "kernel" if jax.default_backend() == "tpu" else "reference"
+    fn = (functools.partial(flash_decode, interpret=interpret)
+          if impl == "kernel" else paged_attention_reference)
+
+    axis = mesh.axis_names[0]
+    tp = int(mesh.devices.size)
+    b, h, d = q.shape
+    kvh = pool_k.shape[2]
+    scales = () if k_scale is None else (k_scale, v_scale)
+    if tp == 1:
+        kw = ({"k_scale": k_scale, "v_scale": v_scale} if scales else {})
+        return fn(q, pool_k, pool_v, tables, lengths, **kw)
+    if h % tp:
+        raise ValueError(f"heads {h} % tp {tp} != 0")
+    hl = h // tp
+    kv_shard = kvh % tp == 0
+    if not kv_shard and tp % kvh:
+        raise ValueError(f"kv_heads {kvh} vs tp {tp}: neither divides")
+
+    pool_spec = P(None, None, axis, None) if kv_shard else P()
+    in_specs = [P(None, axis, None), pool_spec, pool_spec, P(), P()]
+    in_specs += [pool_spec] * len(scales)
+
+    def body(q_l, pk, pv, tb, ln, *sc):
+        i = jax.lax.axis_index(axis)
+        if not kv_shard:
+            def one_kv(x):
+                return jax.lax.dynamic_slice_in_dim(
+                    x, i // (tp // kvh), 1, axis=2)
+            pk, pv = one_kv(pk), one_kv(pv)
+            sc = tuple(one_kv(s) for s in sc)
+        kw = {"k_scale": sc[0], "v_scale": sc[1]} if sc else {}
+        out_l = fn(q_l, pk, pv, tb, ln, **kw)            # [b, h/tp, d]
+        full = jnp.zeros((b, h, d), out_l.dtype)
+        full = jax.lax.dynamic_update_slice(full, out_l, (0, i * hl, 0))
+        return jax.lax.psum(full, axis)
+
+    return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=P(), check_vma=False)(
+        q, pool_k, pool_v, tables, lengths, *scales)

@@ -104,11 +104,31 @@ class ServingEngine:
         ts_interval: int = 32,
         metric_logger=None,
         registry=None,
+        mesh_tensor: Optional[int] = None,
+        mesh_devices: Optional[Sequence[int]] = None,
+        device_block_budget: Optional[int] = None,
     ):
         if spec not in ("off", "ngram", "draft"):
             raise ValueError(f"spec={spec!r} (off | ngram | draft)")
         if max_blocks_per_request is None:
             max_blocks_per_request = -(-config.max_seq_len // block_size)
+        # Tensor parallel: one replica = one mesh (serving/sharding.py).
+        # ``mesh_tensor`` is the mesh size; ``mesh_devices`` optionally
+        # pins the exact device ids (a fleet of disjoint meshes on one
+        # host); ``device_block_budget`` sizes the pool per DEVICE — with
+        # kv-head-sharded pools each device holds 1/tp of every block, so
+        # the replica affords budget * tp total blocks.
+        tp = int(mesh_tensor) if mesh_tensor else 1
+        if mesh_devices is not None:
+            mesh_devices = tuple(int(d) for d in mesh_devices)
+            if tp == 1 and len(mesh_devices) > 1:
+                tp = len(mesh_devices)
+        self.mesh_tensor = tp
+        if device_block_budget is not None and num_blocks is None:
+            from tpu_trainer.serving import sharding as tp_lib
+
+            num_blocks = device_block_budget * tp_lib.shard_factor(
+                config.kv_heads, tp)
         if num_blocks is None:
             # Enough for every slot to run at full context, + null block.
             num_blocks = max_batch * max_blocks_per_request + 1
@@ -123,6 +143,8 @@ class ServingEngine:
             paged_max_blocks=max_blocks_per_request,
             paged_kv_int8=kv_int8,
             paged_attention=attention,
+            paged_tp=tp,
+            paged_tp_devices=(mesh_devices if tp > 1 else None),
         )
         self.params = params
         self.max_batch = max_batch
@@ -170,6 +192,19 @@ class ServingEngine:
         self.metric_logger = metric_logger
         self.serve_ts: List[dict] = []
         self.device_cache = init_paged_cache(self.config, max_batch)
+        if tp > 1:
+            # Commit the replica's persistent device state to the mesh:
+            # pools sharded on kv heads (when divisible), params sharded
+            # on each leaf's largest tp-divisible axis (~P/tp resident
+            # per device; the step gathers them back exactly — see
+            # serving/sharding.py for why greedy streams stay
+            # token-identical).
+            from tpu_trainer.serving import sharding as tp_lib
+
+            mesh = tp_lib.tp_mesh(tp, self.config.paged_tp_devices)
+            self.params = tp_lib.shard_params(self.params, mesh)
+            self.device_cache = tp_lib.shard_cache(
+                self.device_cache, mesh, self.config.kv_heads)
         self._model = GPT(self.config)
         self._step_jit = _jitted_engine_step(self.config)
         self._verify_jit = _jitted_verify_step(self.config)
@@ -741,6 +776,7 @@ class ServingEngine:
         )
         s["prefix_evictions"] = self.cache_state.n_prefix_evictions
         s.update(self.cache_state.fragmentation())
+        s.update(self.scheduler.pool_shard_stats())
         s["queue_depth"] = self.queue_depth
         s["outstanding_tokens"] = self.outstanding_tokens
         s["oldest_wait_s"] = (
@@ -792,10 +828,23 @@ def _engine_step(
 
     model = GPT(dataclasses.replace(config, paged_hist_blocks=hist_blocks))
     cache = jax.tree_util.tree_map_with_path(put, cache)
+    if config.paged_tp > 1:
+        # Sharded replica: params live sharded on the mesh — gather them
+        # to replicated here (an exact concat, no arithmetic) so the
+        # dense compute below is bitwise the single-device compute, and
+        # pin the output cache back to the pool layout so the scatter's
+        # result never drifts off the committed sharding.
+        from tpu_trainer.serving import sharding as tp_lib
+
+        mesh = tp_lib.tp_mesh(config.paged_tp, config.paged_tp_devices)
+        params = tp_lib.gather_params(params, mesh)
     (logits, _), vars_out = model.apply(
         {"params": params, "cache": cache}, ids, decode=True,
         mutable=["cache"],
     )
+    if config.paged_tp > 1:
+        vars_out = {"cache": tp_lib.constrain_cache(
+            vars_out["cache"], mesh, config.kv_heads)}
     if prefill:
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - offsets - 1, 0)[:, None, None],
@@ -816,7 +865,13 @@ def _jitted_engine_step(config):
     engines built with equal configs get the SAME jit object — and with
     it the same compile cache. Constructing a second identically-shaped
     engine (warm-up/timed pairs, A/B lanes, test matrices, the draft
-    proposer reusing the target's step) then costs zero retraces."""
+    proposer reusing the target's step) then costs zero retraces.
+
+    Device/mesh identity is part of the key: the config carries
+    ``(paged_tp, paged_tp_devices)``, so two equal-shaped engines built
+    for different device sets (or sharded vs single-device) never share
+    a jit object — sharing one would dispatch the second engine's steps
+    onto the first engine's devices."""
     return jax.jit(
         functools.partial(_engine_step, config),
         static_argnames=("k_cap", "prefill", "hist_blocks"),
@@ -825,7 +880,9 @@ def _jitted_engine_step(config):
 
 @functools.lru_cache(maxsize=None)
 def _jitted_verify_step(config):
-    """Same per-config sharing for the speculative verify step."""
+    """Same per-config sharing — and the same (paged_tp,
+    paged_tp_devices) mesh-identity keying — for the speculative verify
+    step."""
     return jax.jit(
         functools.partial(_verify_step, config),
         static_argnames=("k_cap", "hist_blocks"),

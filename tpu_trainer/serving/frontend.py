@@ -244,6 +244,7 @@ class ServingFrontend:
         clock=time.perf_counter,
         seed: int = 0,
         replica_factory=None,
+        replica_device_sets=None,
         trace: bool = True,
         ts_interval: int = 32,
         incident_dir: Optional[str] = None,
@@ -289,6 +290,14 @@ class ServingFrontend:
         # RPC worker spec serializes it too).
         engine_kwargs.setdefault("trace", trace)
         self._engine_kwargs = engine_kwargs
+        # Mesh-aware replica placement: one replica = one mesh. Each
+        # entry is a device-id list; replica ``rid`` takes entry
+        # ``rid % len`` so a fleet carves the host's devices into
+        # disjoint tensor-parallel meshes. None = every replica uses
+        # the default devices (engine_kwargs may still set mesh_tensor).
+        self._replica_device_sets = (
+            [tuple(int(d) for d in ds) for ds in replica_device_sets]
+            if replica_device_sets else None)
         # Fleet observability: one merged tracer (front-door events plus
         # replica deltas drained after each step), per-replica flight-
         # recorder rings fed off every event, a serve-loop ledger, and
@@ -461,6 +470,9 @@ class ServingFrontend:
             rep = self._replica_factory(rid, self._now)
         else:
             kw = dict(self._engine_kwargs)
+            if self._replica_device_sets:
+                dsets = self._replica_device_sets
+                kw["mesh_devices"] = dsets[rid % len(dsets)]
             if self._metrics_on:
                 # Per-engine registry, merged into ours label-wise on
                 # each pull — the same shape as a worker process's.

@@ -255,6 +255,25 @@ def load_params_npz(path: str) -> dict:
     return out
 
 
+def _param_nbytes(params) -> int:
+    """Logical byte size of a (possibly nested-Mapping) param tree — the
+    per-worker wire cost a full-copy (non-sharded) launch pays."""
+    import numpy as np
+
+    total = 0
+
+    def walk(node):
+        nonlocal total
+        if hasattr(node, "items"):
+            for v in node.values():
+                walk(v)
+        else:
+            total += int(np.asarray(node).nbytes)
+
+    walk(params)
+    return total
+
+
 # -- transport fault shim (the net_* chaos kinds, utils/faults.py) ---------
 
 NET_DELAY_MS_ENV = "TPU_TRAINER_NET_DELAY_MS"
@@ -629,7 +648,10 @@ class WorkerSupervisor:
                  connect_timeout_s: float = 240.0,
                  rpc_timeout_s: float = 30.0,
                  first_step_timeout_s: float = 600.0,
-                 tcp: bool = False):
+                 tcp: bool = False,
+                 param_shard_world: Optional[int] = None,
+                 device_sets=None,
+                 launch_prefix=None):
         if heartbeat_timeout_s == _AUTO:
             heartbeat_timeout_s = DEFAULT_HEARTBEAT_TIMEOUT_S
         # None = explicit opt-out of flatline detection (exit codes only).
@@ -647,9 +669,38 @@ class WorkerSupervisor:
         self.heartbeat_dir = os.path.join(run_dir, "hb")
         os.makedirs(self.heartbeat_dir, exist_ok=True)
         self._params_path = os.path.join(run_dir, "params.npz")
+        self._shards_path = os.path.join(run_dir, "param_shards")
         self._spec_path = os.path.join(run_dir, "spec.json")
-        if params is not None:
+        # Shard-streaming launch (``param_shard_world``): instead of one
+        # full npz every worker re-reads, the tree is written ONCE as a
+        # ``world``-way host_shards export (utils/checkpoint.py) — the
+        # per-worker shard file is ~P/world bytes, which is what crosses
+        # the wire to a remote host (via the existing TCP transport +
+        # ``launch_prefix``); on a shared filesystem the worker stitches
+        # all shard files back locally. ``param_bytes_full`` /
+        # ``param_shard_bytes`` expose the two wire costs for bench
+        # records. jax-free unless the sharded path is taken (the
+        # checkpoint import is lazy).
+        self.launch_prefix = list(launch_prefix or [])
+        self.param_shard_world = (
+            int(param_shard_world) if param_shard_world else None)
+        self.param_bytes_full = 0
+        self.param_shard_bytes: Optional[List[int]] = None
+        params_shards = None
+        if params is not None and self.param_shard_world:
+            from tpu_trainer.utils.checkpoint import export_param_shards
+
+            export_param_shards(
+                params, self._shards_path, world=self.param_shard_world)
+            params_shards = self._shards_path
+            sdir = os.path.join(self._shards_path, "shards")
+            self.param_shard_bytes = [
+                os.path.getsize(os.path.join(sdir, f"host{h:05d}.npz"))
+                for h in range(self.param_shard_world)]
+            self.param_bytes_full = _param_nbytes(params)
+        elif params is not None:
             save_params_npz(self._params_path, params)
+            self.param_bytes_full = os.path.getsize(self._params_path)
         # One PRNG scheme spans the fleet: the partitionable-threefry
         # flag changes sampled bit streams, so the worker must run with
         # the front-end process's setting or sampled streams lose
@@ -666,6 +717,15 @@ class WorkerSupervisor:
             "params_npz": self._params_path,
             "jax": jax_cfg,
         }
+        if params_shards is not None:
+            spec["params_shards"] = params_shards
+        if device_sets is not None:
+            # Per-worker device sets (disjoint meshes over one host's
+            # devices): worker ``wid`` takes ``device_sets[wid % len]``
+            # as its ``mesh_devices``. Top-level in the spec — engine
+            # kwargs are scalar-only on the wire.
+            spec["device_sets"] = [
+                [int(d) for d in ds] for ds in device_sets]
         for k, v in spec["engine"].items():
             if not isinstance(v, (int, float, str, bool, type(None))):
                 raise ValueError(
@@ -715,6 +775,11 @@ class WorkerSupervisor:
                     os.path.join(self.run_dir, f"worker{wid}.addr")]
         else:
             cmd += ["--socket", os.path.join(self.run_dir, f"w{wid}.sock")]
+        if self.launch_prefix:
+            # e.g. ["ssh", "host"] (remote launch over the TCP transport
+            # + a shared run_dir) or an env wrapper for the fake-device
+            # CPU mesh; the worker command itself is unchanged.
+            cmd = self.launch_prefix + cmd
         with open(log_path, "ab") as log:
             proc = subprocess.Popen(cmd, stdout=log, stderr=log)
         return wid, proc, log_path

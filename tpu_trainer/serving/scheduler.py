@@ -211,6 +211,27 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    def pool_shard_stats(self) -> dict:
+        """Block budget per shard: how the replica's total pool splits
+        over its tensor-parallel mesh (serving/sharding.py). With
+        kv-head-sharded pools each device holds 1/tp of every block, so
+        ``device_pool_blocks = total / tp`` — the per-device HBM budget
+        the engine's ``device_block_budget`` kwarg sizes against. On an
+        unsharded replica (tp=1, or GQA-replicated pools) device ==
+        total. Scheduling itself is shard-agnostic — block accounting
+        is in whole (logical) blocks either way."""
+        cfg = self.cache.config
+        tp = getattr(cfg, "paged_tp", 1)
+        from tpu_trainer.serving.sharding import shard_factor
+
+        total = cfg.paged_num_blocks
+        return {
+            "tp": int(tp),
+            "total_pool_blocks": int(total),
+            "device_pool_blocks": int(
+                total // shard_factor(cfg.kv_heads, tp)),
+        }
+
     # -- load signals (cheap, host-only — the multi-replica router's
     # routing/admission inputs, and useful standalone telemetry) ----------
 

@@ -406,14 +406,24 @@ def _verify_step(
 
     model = GPT(dataclasses.replace(config, paged_hist_blocks=hist_blocks))
     cache = jax.tree_util.tree_map_with_path(put, cache)
+    if config.paged_tp > 1:
+        # Sharded replica: exact params all-gather in, pool-layout
+        # constraint out — same contract as engine._engine_step.
+        from tpu_trainer.serving import sharding as tp_lib
+
+        mesh = tp_lib.tp_mesh(config.paged_tp, config.paged_tp_devices)
+        params = tp_lib.gather_params(params, mesh)
     (logits, _), vars_out = model.apply(
         {"params": params, "cache": cache}, ids, decode=True,
         mutable=["cache"],
     )
+    cache_out = vars_out["cache"]
+    if config.paged_tp > 1:
+        cache_out = tp_lib.constrain_cache(cache_out, mesh, config.kv_heads)
     emitted, n_acc = accept_emit(
         logits.astype(jnp.float32), ids, draft_lens, temps, topks, topps,
         keys, steps, k_cap=k_cap)
-    return vars_out["cache"], emitted, n_acc
+    return cache_out, emitted, n_acc
 
 
 # --- orchestration state ----------------------------------------------------

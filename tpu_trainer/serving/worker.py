@@ -100,15 +100,32 @@ class WorkerServer:
         from tpu_trainer.obs.metrics import MetricsRegistry
         from tpu_trainer.serving.engine import ServingEngine
 
-        params = load_params_npz(self.spec["params_npz"])
+        if self.spec.get("params_shards"):
+            # Shard-streaming launch: params arrive as a host_shards
+            # export (one ~P/world file per worker on the wire; a
+            # shared-filesystem worker stitches the full tree from all
+            # of them here, then the engine re-commits it to its own
+            # mesh). The full-npz path below stays the single-device
+            # fallback.
+            from tpu_trainer.utils.checkpoint import load_param_shards
+
+            params = load_param_shards(self.spec["params_shards"])
+        else:
+            params = load_params_npz(self.spec["params_npz"])
         config = GPTConfig(**self.spec["config"])
+        kw = dict(self.spec.get("engine", {}))
+        dsets = self.spec.get("device_sets")
+        if dsets:
+            # This worker's mesh device set: disjoint meshes over one
+            # host's visible devices, assigned round-robin by worker id.
+            kw["mesh_devices"] = tuple(
+                int(d) for d in dsets[self.worker_id % len(dsets)])
         # Every worker engine gets a live registry: the front-end pulls
         # snapshots over the ``metrics`` verb and merges them label-wise
         # (replica=N) into its own registry. Single-threaded here — the
         # reactor owns both the engine and the scrape.
         eng = ServingEngine(params, config, clock=lambda: self._now_value,
-                            registry=MetricsRegistry(),
-                            **self.spec.get("engine", {}))
+                            registry=MetricsRegistry(), **kw)
         eng._t0 = 0.0   # front-end clock domain: timestamps ARE its times
         return eng
 

@@ -271,6 +271,9 @@ def summarize(records: List[dict]) -> dict:
             "prefix_evictions", "spec", "spec_k", "spec_steps",
             "spec_drafted", "spec_accepted", "spec_accept_mean",
             "spec_accept_rate", "spec_accept_hist",
+            "tp", "device_pool_blocks", "total_pool_blocks",
+            "peak_pool_blocks", "wire_bytes_per_worker", "wire_ratio",
+            "tp_token_match",
             ) if s.get(k) is not None}
 
     fronts = by_kind.get("frontend", [])
@@ -291,7 +294,25 @@ def summarize(records: List[dict]) -> dict:
             "failover_events", "failed_over_requests", "wait_age_p99_s",
             "transport", "workers", "worker_deaths",
             "finished", "cancelled", "deadline_exceeded",
+            "tp", "device_pool_blocks", "total_pool_blocks",
+            "wire_bytes_per_worker", "wire_ratio", "tp_token_match",
             ) if f.get(k) is not None}
+
+    # Sharded-decode (tensor-parallel) parity: EVERY record that carries
+    # a tp_token_match verdict counts — the bench stamps one per lane
+    # compared against the unsharded / no-fault base lane, so one
+    # mismatch anywhere in the file is a real divergence, not noise the
+    # newest record should shadow.
+    tp_recs = [r for r in serves + fronts
+               if r.get("tp_token_match") is not None]
+    if tp_recs:
+        bad = [r.get("lane") for r in tp_recs if not r["tp_token_match"]]
+        report["tp_parity"] = {
+            "tp": max(int(r.get("tp") or 0) for r in tp_recs),
+            "records": len(tp_recs),
+            "mismatched": len(bad),
+            "mismatched_lanes": bad,
+        }
         # Lifecycle / chaos metrics (deadline misses, hung-RPC stalls,
         # fence counts) live on whichever lane carried the deadline or
         # fault — scan for the newest record with each, like the RPC
@@ -684,6 +705,20 @@ def render(report: dict) -> List[str]:
                 f" ({s.get('prefix_hit_tokens') or 0}"
                 f"/{s.get('prompt_tokens') or 0} prompt tokens,"
                 f" {s.get('prefix_evictions') or 0} evictions)")
+        if s.get("tp") and s.get("tp") > 1:
+            wire = ""
+            if s.get("wire_bytes_per_worker") is not None:
+                wire = (f" | wire/worker {s['wire_bytes_per_worker']} B"
+                        f" ({_fmt(s.get('wire_ratio'))}x full/tp)")
+            match = s.get("tp_token_match")
+            lines.append(
+                f"serve   tp {s['tp']}:"
+                f" {s.get('device_pool_blocks')} blocks/device"
+                f" x{s['tp']} = {s.get('total_pool_blocks')} total"
+                f" (peak {s.get('peak_pool_blocks', '-')})"
+                f"{wire}"
+                + ("" if match is None else
+                   f" | token match {'ok' if match else 'DIVERGED'}"))
         if s.get("spec") and s.get("spec") != "off":
             lines.append(
                 f"serve   spec {s['spec']} k={s.get('spec_k')}:"
@@ -693,6 +728,14 @@ def render(report: dict) -> List[str]:
                 f"/{s.get('spec_drafted') or 0} over"
                 f" {s.get('spec_steps') or 0} verify steps)"
                 f" hist {s.get('spec_accept_hist')}")
+    tpp = report.get("tp_parity")
+    if tpp:
+        lines.append(
+            f"tp      parity tp={tpp['tp']}: {tpp['records']} sharded"
+            f" lanes, {tpp['mismatched']} diverged"
+            + (f" ({', '.join(str(x) for x in tpp['mismatched_lanes'])})"
+               f"  ** SHARDED STREAMS DIVERGED **"
+               if tpp["mismatched"] else " (all bit-exact)"))
     fe = report.get("frontend")
     if fe:
         lines.append(
@@ -735,6 +778,14 @@ def render(report: dict) -> List[str]:
                     f" {_fmt((fe.get('rpc_overhead_p99_s') or 0) * 1e3, 1)}ms")
             if fe.get("tok_s_vs_inproc") is not None:
                 line += f" | tok/s x{_fmt(fe.get('tok_s_vs_inproc'))} vs in-process"
+            lines.append(line)
+        if fe.get("tp") and fe.get("tp") > 1:
+            line = (f"frontend tp {fe['tp']} per replica:"
+                    f" {fe.get('device_pool_blocks')} blocks/device"
+                    f" x{fe['tp']} = {fe.get('total_pool_blocks')} total")
+            if fe.get("wire_bytes_per_worker") is not None:
+                line += (f" | wire/worker {fe['wire_bytes_per_worker']} B"
+                         f" ({_fmt(fe.get('wire_ratio'))}x full/tp)")
             lines.append(line)
         ab = fe.get("ab")
         if ab:
@@ -854,7 +905,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             rpc_overhead_tol: float = 1.0,
             deadline_miss_tol: float = 0.05,
             stall_recovery_tol: float = 30.0,
-            queue_wait_tol: float = 1.0) -> List[dict]:
+            queue_wait_tol: float = 1.0,
+            tp_parity_tol: float = 0.0) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -1185,6 +1237,30 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "absolute": True,
         })
 
+    # Sharded-decode parity is categorical, like span conservation: a
+    # tensor-parallel lane whose greedy stream diverges from its
+    # unsharded (or no-fault) base lane leaked the sharded compute path
+    # into the tokens — exactness is by construction, so ANY mismatch
+    # past ``tp_parity_tol`` (a fraction of sharded lanes, default 0 —
+    # one diverged lane fails) is a bug, not a regression to tolerate.
+    # SKIP when the new run served nothing sharded.
+    n_tpp = get(new, "tp_parity") or {}
+    if not n_tpp:
+        verdicts.append({"metric": "serve_tp_parity", "verdict": "SKIP",
+                         "base": (get(base, "tp_parity") or {}).get(
+                             "mismatched"),
+                         "new": None})
+    else:
+        frac = n_tpp["mismatched"] / max(n_tpp["records"], 1)
+        verdicts.append({
+            "metric": "serve_tp_parity",
+            "verdict": "FAIL" if frac > tp_parity_tol + eps else "PASS",
+            "base": (get(base, "tp_parity") or {}).get("mismatched"),
+            "new": n_tpp["mismatched"],
+            "tolerance": tp_parity_tol,
+            "absolute": True,
+        })
+
     # Affinity-vs-random A/B (both hit rates come from the SAME run's
     # record set — see summarize — so this never compares across trees).
     n_ab = get(new, "frontend", "ab") or {}
@@ -1434,6 +1510,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "queue waits. Span conservation needs no "
                              "tolerance: an opened rid without exactly one "
                              "terminal event is a categorical FAIL")
+    parser.add_argument("--tp-parity-tol", type=float, default=0.0,
+                        help="ABSOLUTE gate on sharded (tensor-parallel) "
+                             "decode: FAIL if more than this fraction of "
+                             "the run's sharded lanes diverged token-wise "
+                             "from their unsharded / no-fault base lane "
+                             "(default 0.0 — sharded decode is exact by "
+                             "construction, one diverged lane fails); "
+                             "SKIP when the run served nothing sharded")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -1464,7 +1548,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             rpc_overhead_tol=args.rpc_overhead_tol,
             deadline_miss_tol=args.deadline_miss_tol,
             stall_recovery_tol=args.stall_recovery_tol,
-            queue_wait_tol=args.queue_wait_tol)
+            queue_wait_tol=args.queue_wait_tol,
+            tp_parity_tol=args.tp_parity_tol)
 
     exit_code = (1 if verdicts is not None
                  and any(v["verdict"] == "FAIL" for v in verdicts) else 0)

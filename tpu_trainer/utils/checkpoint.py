@@ -929,6 +929,138 @@ def _assemble_host_shards(
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _pick_export_axis(shape: Tuple[int, ...], world: int) -> Optional[int]:
+    """Wire-slicing rule for :func:`export_param_shards`: the largest axis
+    with at least ``world`` elements (ties -> lowest axis index), or None
+    to ship the leaf whole in worker 0's file. Deliberately looser than
+    the device-placement rule (serving/sharding.pick_shard_axis, which
+    needs exact divisibility for ``NamedSharding``): the wire layout is
+    independent of the device layout — workers stitch the full tree and
+    the engine re-commits it to its own mesh — so near-equal chunks of a
+    non-divisible axis (a 50257-row embedding over tp=8) still split and
+    keep per-worker bytes ~ P/world."""
+    best = None
+    for ax, n in enumerate(shape):
+        if n >= world and (best is None or n > shape[best]):
+            best = ax
+    return best
+
+
+def export_param_shards(params, path: str, *, world: int) -> str:
+    """Write an inference params tree as a ``world``-way sharded
+    two-phase ``host_shards`` checkpoint — the shard-streaming serving
+    launch format. Worker *i* of a tp=``world`` fleet ships (or mounts)
+    only ``shards/host0000i.npz`` — ~P/world bytes — instead of a full
+    npz copy per worker; :func:`load_param_shards` reassembles.
+
+    Each leaf splits into near-equal contiguous chunks on its largest
+    axis (see ``_pick_export_axis``); leaves too small to split ride
+    whole in worker 0's file. Slicing is pure ``np.ndarray`` copying —
+    byte-lossless round-trip, no dtype or value changes — and reuses the
+    training checkpoint's shard/manifest/DONE-marker/meta machinery, so
+    the on-disk format (and its torn-write crash contract) is the one
+    restore tooling already understands. ``params`` is a (possibly
+    nested) dict of arrays; keys are joined with ``/``."""
+    if world < 1:
+        raise ValueError(f"world={world} < 1")
+    flat: Dict[str, np.ndarray] = {}
+
+    def walk(prefix: str, node) -> None:
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(f"{prefix}/{k}" if prefix else str(k), node[k])
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", params)
+    for host in range(world):
+        snap = _HostShardSnapshot()
+        for key, arr in flat.items():
+            ax = _pick_export_axis(arr.shape, world) if world > 1 else None
+            if ax is None:
+                shards = (
+                    [(tuple(0 for _ in arr.shape), arr)] if host == 0
+                    else [])
+            else:
+                n = arr.shape[ax]
+                base, extra = divmod(n, world)
+                start = host * base + min(host, extra)
+                size = base + (1 if host < extra else 0)
+                sl = [slice(None)] * arr.ndim
+                sl[ax] = slice(start, start + size)
+                starts = tuple(
+                    start if a == ax else 0 for a in range(arr.ndim))
+                shards = [(starts, np.ascontiguousarray(arr[tuple(sl)]))]
+            snap.append({
+                "key": key,
+                "global_shape": tuple(arr.shape),
+                "dtype": str(arr.dtype),
+                "shards": shards,
+            })
+        _write_host_shards(path, snap, host=host, world=world)
+        _mark_host_done(path, host=host, world=world)
+    _write_meta(path, {
+        "format": HOST_SHARDS_FORMAT,
+        "shard_world": world,
+        "kind": "param_shards",
+    })
+    return path
+
+
+def load_param_shards(path: str) -> dict:
+    """Stitch an :func:`export_param_shards` directory back into the
+    nested numpy params dict (byte-identical to the exported tree). The
+    meta/manifest completeness checks mirror ``_assemble_host_shards``:
+    a missing host file or torn meta raises ValueError rather than
+    returning a silently partial tree."""
+    meta = load_meta(path)
+    if meta.get("format") != HOST_SHARDS_FORMAT:
+        raise ValueError(f"{path} is not a host_shards export")
+    world = meta.get("shard_world")
+    sdir = os.path.join(path, _SHARDS_SUBDIR)
+    try:
+        manifests = sorted(
+            n for n in os.listdir(sdir)
+            if n.startswith("host") and n.endswith(".json")
+        )
+    except OSError as e:
+        raise ValueError(f"unreadable shards dir {sdir}: {e}")
+    if world is not None and len(manifests) < world:
+        raise ValueError(
+            f"param_shards export {path} incomplete: "
+            f"{len(manifests)}/{world} host manifests")
+    globals_np: Dict[str, np.ndarray] = {}
+    for man_name in manifests:
+        with open(os.path.join(sdir, man_name)) as f:
+            manifest = json.load(f)
+        npz_name = man_name[:-len(".json")] + ".npz"
+        with np.load(os.path.join(sdir, npz_name)) as data:
+            for leaf in manifest["leaves"]:
+                dtype = _resolve_dtype(leaf["dtype"])
+                shape = tuple(leaf["global_shape"])
+                buf = globals_np.get(leaf["key"])
+                if buf is None:
+                    buf = np.zeros(shape, dtype=dtype)
+                    globals_np[leaf["key"]] = buf
+                for sh in leaf["shards"]:
+                    arr = np.frombuffer(
+                        data[sh["name"]].tobytes(), dtype=dtype
+                    ).reshape(sh["shape"])
+                    idx = tuple(
+                        slice(st, st + ln)
+                        for st, ln in zip(sh["start"], sh["shape"])
+                    )
+                    buf[idx] = arr
+    out: dict = {}
+    for key, arr in globals_np.items():
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
 def remap_data_state(
     data_state: Optional[dict],
     *,
