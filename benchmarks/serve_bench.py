@@ -44,6 +44,12 @@ the same multi-group shared-prefix trace (``--prefix-groups``, default
 ``2*replicas+2`` — more hot prefixes than replicas), ``--replica-kill
 N`` adds a lane that kills one replica at front-end iteration N
 mid-run, and ``--max-queue`` / ``--wait-watermark`` bound admission.
+``--disagg P:D`` runs the disaggregated prefill/decode lanes over the
+fleet KV store (``serving/kv_store.py``): a symmetric affinity
+baseline, the same fleet sharing the digest-addressed store, and a
+P-prefill/D-decode fleet migrating finished prefills — gated on fleet
+hit rate beating the baseline and on migrated greedy streams staying
+bit-identical to a single undisturbed engine.
 Emits ``kind="frontend"`` records (aggregate tok/s, per-replica prefix
 hit rates, reject rate, load imbalance, failover counts) gated by
 ``analyze.py --reject-tol`` and its categorical affinity-vs-random
@@ -255,6 +261,19 @@ def main(argv=None) -> int:
                         "worker process at this front-end iteration "
                         "(worker_kill fault) and proves cross-process "
                         "failover drains")
+    p.add_argument("--disagg", default=None, metavar="P:D",
+                   help="disaggregated prefill/decode lanes: P prefill + "
+                        "D decode replicas over the fleet KV block "
+                        "store. Runs a symmetric affinity baseline, the "
+                        "same fleet sharing the digest store, and the "
+                        "role-split fleet migrating finished prefills; "
+                        "gates fleet hit rate above the baseline and "
+                        "migrated greedy streams bit-identical to a "
+                        "single undisturbed engine. With --workers the "
+                        "lanes run cross-process (kv_put/kv_get RPC)")
+    p.add_argument("--kv-store-mb", type=int, default=0,
+                   help="fleet KV block store host-tier budget in MiB "
+                        "(0 = no store; --disagg defaults it to 64)")
     p.add_argument("--replica-kill", type=int, default=0,
                    help="with --replicas: add a lane that kills one "
                         "replica at this front-end iteration "
@@ -349,6 +368,23 @@ def main(argv=None) -> int:
     if (args.worker_hang > 0 or args.net_fault) and args.workers <= 0:
         p.error("--worker-hang/--net-fault need --workers (they fault "
                 "the RPC transport)")
+
+    args._disagg_roles = None
+    if args.disagg:
+        try:
+            n_pre, n_dec = (int(x) for x in args.disagg.split(":"))
+        except ValueError:
+            n_pre = n_dec = 0
+        if n_pre < 1 or n_dec < 1:
+            p.error("--disagg wants P:D with at least one prefill and "
+                    "one decode replica (e.g. 1:2)")
+        if args.replicas not in (0, n_pre + n_dec):
+            p.error(f"--disagg {args.disagg} is a fleet of "
+                    f"{n_pre + n_dec}; --replicas/--workers disagree")
+        args.replicas = n_pre + n_dec
+        if args.kv_store_mb <= 0:
+            args.kv_store_mb = 64
+        args._disagg_roles = ["prefill"] * n_pre + ["decode"] * n_dec
 
     if args.smoke:
         args.requests = 16
@@ -1258,8 +1294,28 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         mesh_dsets = [[i * tp + j for j in range(tp)]
                       for i in range(n_sets)]
     supervisors = []
+    kv_bytes = (args.kv_store_mb << 20) if args.kv_store_mb > 0 else 0
+    disagg_roles = args._disagg_roles
+    if disagg_roles and args.workers > 0:
+        # Cross-process disagg lanes replay with open-loop arrivals even
+        # when the workload says t=0: worker-local stores synchronize at
+        # submit time from a catalog that learns off load snapshots, so
+        # an all-at-once burst leaves nothing to share — steady-state
+        # traffic (the shape the tier exists for) needs spacing wider
+        # than the RPC step cadence. In-process fleets share one store
+        # OBJECT, so late admissions hit it without any stagger. Greedy
+        # streams are arrival-time independent, so the single-engine
+        # pin and every stream gate still hold.
+        inner_trace = make_trace
 
-    def make_supervisor():
+        def make_trace():
+            trace = inner_trace()
+            if all(r.arrival_time == 0.0 for r in trace):
+                for i, r in enumerate(trace):
+                    r.arrival_time = 0.1 * i
+            return trace
+
+    def make_supervisor(extra=None):
         from tpu_trainer.serving.remote import WorkerSupervisor
 
         sup_kwargs = {}
@@ -1271,16 +1327,26 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             sup_kwargs["param_shard_world"] = tp
             sup_kwargs["device_sets"] = mesh_dsets
         # Worker processes build their engines from this spec, so the
-        # tracing switch must travel with it for the fleet to agree.
+        # tracing switch must travel with it for the fleet to agree —
+        # and so must the per-worker KV store budget (extra), which is
+        # what the kv_put/kv_get verbs synchronize.
         sup = WorkerSupervisor(
             params, cfg,
-            engine_kwargs=dict(engine_kwargs, trace=not args.no_trace),
+            engine_kwargs=dict(engine_kwargs, trace=not args.no_trace,
+                               **(extra or {})),
             **sup_kwargs)
         sup.prewarm(args.replicas)
         supervisors.append(sup)
         return sup
 
-    def build(routing, sup=None, incident_dir=None, registry=None):
+    def build(routing, sup=None, incident_dir=None, registry=None,
+              kv=False, fleet_roles=None):
+        kw = dict(engine_kwargs)
+        if kv and kv_bytes:
+            # In-process fleets build ONE shared KVBlockStore from this;
+            # RPC fleets ignore it here (each worker holds a local store
+            # from the supervisor's engine kwargs).
+            kw["kv_store_bytes"] = kv_bytes
         return ServingFrontend(
             params, cfg, replicas=args.replicas, routing=routing,
             max_queue_depth=args.max_queue or max(args.requests, 1),
@@ -1288,8 +1354,8 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             seed=args.seed, replica_factory=sup,
             replica_device_sets=(mesh_dsets if sup is None else None),
             trace=not args.no_trace, incident_dir=incident_dir,
-            registry=registry,
-            **engine_kwargs,
+            registry=registry, replica_roles=fleet_roles,
+            **kw,
         )
 
     def timed_trace():
@@ -1306,7 +1372,8 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     obs_records = []   # kind:"span"/"serve_ts"/"incident" riding --out
     metrics_failures = []   # --metrics-port gate violations, all lanes
 
-    def run_lane(lane, routing, fault_spec=None, transport="inproc"):
+    def run_lane(lane, routing, fault_spec=None, transport="inproc",
+                 kv=False, fleet_roles=None):
         # Incidents dump per lane (the warm-up front-end gets no dir: a
         # compile-run artifact would shadow the timed drill's dump).
         inc_dir = (os.path.join(args.incident_dir, lane)
@@ -1321,14 +1388,19 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             # survive into the timed run: reset() rebuilds each worker's
             # engine in place (per-config jit cache kept) and the timed
             # front-end adopts the warm processes from the pool.
-            sup = make_supervisor()
-            build(routing, sup).run(make_trace())
+            sup = make_supervisor(
+                extra=({"kv_store_bytes": kv_bytes}
+                       if kv and kv_bytes else None))
+            build(routing, sup, kv=kv,
+                  fleet_roles=fleet_roles).run(make_trace())
             sup.reset()
             fe = build(routing, sup, incident_dir=inc_dir,
-                       registry=registry)
+                       registry=registry, kv=kv, fleet_roles=fleet_roles)
         else:
-            build(routing).run(make_trace())   # warm-up: compiles shapes
-            fe = build(routing, incident_dir=inc_dir, registry=registry)
+            # warm-up: compiles shapes
+            build(routing, kv=kv, fleet_roles=fleet_roles).run(make_trace())
+            fe = build(routing, incident_dir=inc_dir, registry=registry,
+                       kv=kv, fleet_roles=fleet_roles)
         mserver = scraper = None
         if registry is not None:
             from tpu_trainer.obs.http import MetricsServer
@@ -1387,6 +1459,16 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             "prompt_tokens": int(s["prompt_tokens"]),
             "prefix_hit_tokens": int(s["prefix_hit_tokens"]),
             "prefix_hit_rate": round(float(s["prefix_hit_rate"]), 4),
+            # Token-weighted fleet aggregate plus the store-tier split:
+            # store-hit tokens are prompt tokens whose prefill was
+            # SKIPPED because the fleet store already held the blocks.
+            "fleet_prefix_hit_rate": round(
+                float(s["fleet_prefix_hit_rate"]), 4),
+            "store_hit_tokens": int(s["store_hit_tokens"]),
+            "store_hit_tokens_host": int(s["store_hit_tokens_host"]),
+            "store_hit_tokens_disk": int(s["store_hit_tokens_disk"]),
+            "migrations": int(s["migrations"]),
+            "migrated_bytes": int(s["migrated_bytes"]),
             "load_imbalance_mean": round(float(s["load_imbalance_mean"]), 3),
             "load_imbalance_max": round(float(s["load_imbalance_max"]), 3),
             "failover_events": int(s["failover_events"]),
@@ -1399,7 +1481,10 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
                 {"replica": p["replica"], "alive": p["alive"],
                  "finished": p["finished"],
                  "generated_tokens": p["generated_tokens"],
-                 "prefix_hit_rate": round(p["prefix_hit_rate"], 4)}
+                 "prefix_hit_rate": round(p["prefix_hit_rate"], 4),
+                 **({"role": p["role"]} if p.get("role") else {}),
+                 **({"store_hit_tokens": int(p["store_hit_tokens"])}
+                    if p.get("store_hit_tokens") else {})}
                 for p in s["per_replica"]],
         }
         for k in ("deadline_miss_rate", "deadline_miss_slack_p50",
@@ -1503,34 +1588,62 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
         return record, drained, ttfts, streams
 
     workers_mode = args.workers > 0
-    if workers_mode:
+    NO_KV = (False, None)
+    if disagg_roles:
+        # Disaggregation lanes: (A) the symmetric fleet on the chosen
+        # routing with per-replica caches only — the baseline the fleet
+        # store must beat; (B) the same symmetric fleet routed for LOAD
+        # (least_loaded scatters every prefix group over every replica —
+        # the per-replica-cache worst case) but sharing the digest
+        # store, which turns the scattered misses back into hits; (C)
+        # the role-split fleet migrating finished prefills to decode
+        # replicas. Cross-process with --workers (worker-local stores
+        # over the kv verbs).
+        tport = "rpc" if workers_mode else "inproc"
+        lanes = [("affinity_base", args.routing, None, tport, False, None),
+                 ("kv_store", "least_loaded", None, tport, True, None),
+                 ("disagg", args.routing, None, tport, True, disagg_roles)]
+        if args.worker_kill > 0 and workers_mode:
+            # The role-split fleet again, SIGKILLing a worker mid-run
+            # (TPU_TRAINER_FAULT_REPLICA=0 targets the prefill replica —
+            # the interesting death: it dies holding streams mid-
+            # migration). Roles are a performance shape, never a
+            # correctness dependency, so the decode survivors must
+            # prefill the failed-over work themselves and still match
+            # the single-engine pin bit-exactly.
+            lanes.append(("disagg_kill", args.routing,
+                          f"worker_kill@{args.worker_kill}", "rpc",
+                          True, disagg_roles))
+    elif workers_mode:
         # Transport A/B: the same trace, same routing, same fleet size —
         # in-process vs one-OS-process-per-replica over RPC.
-        lanes = [("inproc", args.routing, None, "inproc")] if args.ab else []
-        lanes.append(("rpc", args.routing, None, "rpc"))
+        lanes = ([("inproc", args.routing, None, "inproc") + NO_KV]
+                 if args.ab else [])
+        lanes.append(("rpc", args.routing, None, "rpc") + NO_KV)
         if args.worker_kill > 0:
             lanes.append(("worker_kill", args.routing,
-                          f"worker_kill@{args.worker_kill}", "rpc"))
+                          f"worker_kill@{args.worker_kill}", "rpc") + NO_KV)
         if args.worker_hang > 0:
             lanes.append(("worker_hang", args.routing,
-                          f"worker_hang@{args.worker_hang}", "rpc"))
+                          f"worker_hang@{args.worker_hang}", "rpc") + NO_KV)
         if args.net_fault:
-            lanes.append(("net_fault", args.routing, args.net_fault, "rpc"))
+            lanes.append(
+                ("net_fault", args.routing, args.net_fault, "rpc") + NO_KV)
     elif args.ab:
         b_routing = args.routing if args.routing != "random" else "affinity"
-        lanes = [("random", "random", None, "inproc"),
-                 (b_routing, b_routing, None, "inproc")]
+        lanes = [("random", "random", None, "inproc") + NO_KV,
+                 (b_routing, b_routing, None, "inproc") + NO_KV]
     else:
-        lanes = [(args.routing, args.routing, None, "inproc")]
-    if args.replica_kill > 0 and not workers_mode:
+        lanes = [(args.routing, args.routing, None, "inproc") + NO_KV]
+    if args.replica_kill > 0 and not workers_mode and not disagg_roles:
         lanes.append(("replica_kill", args.routing,
-                      f"replica_kill@{args.replica_kill}", "inproc"))
+                      f"replica_kill@{args.replica_kill}", "inproc") + NO_KV)
 
     records, all_drained, lane_ttfts, lane_streams = [], True, {}, {}
     try:
-        for lane, routing, spec, transport in lanes:
+        for lane, routing, spec, transport, kv, fleet_roles in lanes:
             rec, drained, ttfts, streams = run_lane(
-                lane, routing, spec, transport)
+                lane, routing, spec, transport, kv, fleet_roles)
             all_drained = all_drained and drained
             records.append(rec)
             lane_ttfts[lane] = ttfts
@@ -1563,6 +1676,77 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
                     f"lane {rec['lane']}: sharded streams diverge from "
                     f"lane {base_lane}")
 
+    disagg_failures = []
+    if disagg_roles and records:
+        # The correctness pin: a single undisturbed engine serves the
+        # whole trace alone. Store fills and prefill->decode migration
+        # are pure data movement of bit-exact K/V, so every store lane's
+        # greedy streams must match it token for token; and the fleet
+        # store must earn its bytes — token-weighted fleet hit rate
+        # strictly above the per-replica-cache baseline.
+        from tpu_trainer.serving.engine import ServingEngine
+
+        pin_eng = ServingEngine(
+            params, cfg, max_batch=args.concurrency,
+            block_size=args.block_size, num_blocks=args.num_blocks or None,
+            kv_int8=args.kv_int8, attention=args.attention,
+            prefill_chunk_tokens=args.prefill_chunk or None,
+            prefix_cache=True, trace=False)
+        pin = {r.rid: list(r.generated)
+               for r in pin_eng.run(make_trace())}
+        base = next(r for r in records if r["lane"] == "affinity_base")
+        for rec in records:
+            if rec["lane"] == "affinity_base":
+                continue
+            streams = lane_streams[rec["lane"]]
+            rec["disagg_token_match"] = all(
+                pin[rid] == gen for rid, gen in streams.items()
+                if rid in pin)
+            rec["baseline_prefix_hit_rate"] = base["prefix_hit_rate"]
+            if not rec["disagg_token_match"]:
+                disagg_failures.append(
+                    f"lane {rec['lane']}: store-filled/migrated greedy "
+                    f"streams diverge from the single undisturbed engine")
+            if rec["store_hit_tokens"] < 1:
+                disagg_failures.append(
+                    f"lane {rec['lane']}: the fleet store skipped no "
+                    f"prefill tokens (store_hit_tokens == 0)")
+        # The scattered-but-shared lane must RECOVER affinity's hit rate
+        # (its win is load balance at equal hits: every group's cold
+        # prefill is paid once fleet-wide either way); the disagg lane
+        # must strictly BEAT it — decode admission skips prefill work
+        # the prefill tier already paid.
+        # In-process the store is one shared object, so recovery is
+        # exact up to a small admission-order slack. Cross-process the
+        # sync is submit-time opportunistic (catalog learns from load
+        # snapshots), so the recovery RATE depends on arrival spacing
+        # vs step cadence — there the store_hit_tokens gate above
+        # proves the verbs moved real blocks, and the recovered rate is
+        # reported, not gated.
+        kvr = next(r for r in records if r["lane"] == "kv_store")
+        if (not workers_mode and kvr["fleet_prefix_hit_rate"]
+                < base["prefix_hit_rate"] - 0.05):
+            disagg_failures.append(
+                f"lane kv_store: fleet prefix hit rate "
+                f"{kvr['fleet_prefix_hit_rate']} below the per-replica "
+                f"affinity baseline {base['prefix_hit_rate']}")
+        dis = next(r for r in records if r["lane"] == "disagg")
+        if dis["fleet_prefix_hit_rate"] <= base["prefix_hit_rate"]:
+            disagg_failures.append(
+                f"lane disagg: fleet prefix hit rate "
+                f"{dis['fleet_prefix_hit_rate']} not strictly above the "
+                f"per-replica affinity baseline {base['prefix_hit_rate']}")
+        if dis["migrations"] < 1:
+            disagg_failures.append(
+                "disagg lane migrated no requests (prefill replicas "
+                "never handed a stream to a decode replica)")
+        kill = next((r for r in records if r["lane"] == "disagg_kill"),
+                    None)
+        if kill is not None and not kill.get("worker_deaths"):
+            disagg_failures.append(
+                "disagg_kill lane observed no worker death (the fault "
+                "never fired — nothing was proven)")
+
     if workers_mode and args.ab and len(records) >= 2:
         a = next(r for r in records if r["transport"] == "inproc")
         b = next(r for r in records if r["transport"] == "rpc")
@@ -1592,7 +1776,19 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
     for rec in records:
         _print_frontend_record(rec)
         print(json.dumps(rec), flush=True)
-    if workers_mode:
+    if disagg_roles and records:
+        base = next(r for r in records if r["lane"] == "affinity_base")
+        dis = next(r for r in records if r["lane"] == "disagg")
+        print(f"A/B     disagg {args.disagg} vs symmetric baseline: "
+              f"fleet hit {dis['fleet_prefix_hit_rate']:.2f} vs "
+              f"{base['prefix_hit_rate']:.2f}, {dis['migrations']} "
+              f"migrations ({dis['migrated_bytes']} B), store-hit "
+              f"tokens {dis['store_hit_tokens']}, stream match "
+              f"{'bit-exact' if dis['disagg_token_match'] else 'DIVERGED'}",
+              flush=True)
+        if args.update_md:
+            update_disagg_md(workload, records, args)
+    elif workers_mode:
         if args.ab and len(records) >= 2:
             b = next(r for r in records if r["transport"] == "rpc")
             print(f"A/B     rpc vs in-process: tok/s "
@@ -1631,6 +1827,7 @@ def _run_frontend_lanes(args, params, cfg, make_trace, workload) -> int:
             failures.append(
                 f"p99 TTFT {p99}s > gate {args.ttft_p99_gate}s")
     failures.extend(tp_failures)
+    failures.extend(disagg_failures)
     failures.extend(metrics_failures)
     for f in failures:
         print(f"GATE FAIL: {f}", file=sys.stderr, flush=True)
@@ -1673,6 +1870,16 @@ def _print_frontend_record(r) -> None:
         print(f"spans   {r.get('span_events', 0)} events, conservation "
               f"{'ok' if r.get('span_conservation_ok') else 'BROKEN'} | "
               f"incidents {r.get('incidents', 0)}", flush=True)
+    if r.get("store_hit_tokens") or r.get("migrations"):
+        line = (f"store   fleet hit {r['fleet_prefix_hit_rate']:.2f}, "
+                f"store-hit tokens {r['store_hit_tokens']} "
+                f"(host {r['store_hit_tokens_host']} / disk "
+                f"{r['store_hit_tokens_disk']}), migrations "
+                f"{r['migrations']} ({r['migrated_bytes']} B)")
+        if r.get("disagg_token_match") is not None:
+            line += (f", stream match "
+                     f"{'bit-exact' if r['disagg_token_match'] else 'DIVERGED'}")
+        print(line, flush=True)
     per = "/".join(f"{p['prefix_hit_rate']:.2f}" for p in r["per_replica"])
     print(f"fleet   prefix hit rate {r['prefix_hit_rate']:.2f} "
           f"(per-replica {per}) | reject rate {r['reject_rate']:.3f} "
@@ -1734,6 +1941,70 @@ def update_frontend_md(workload, records, args) -> None:
     with open(_RESULTS_MD, "w") as f:
         f.write(text)
     print(f"wrote multi-replica serving table to {_RESULTS_MD}",
+          file=sys.stderr)
+
+
+def update_disagg_md(workload, records, args) -> None:
+    """Splice the disaggregated-serving lane table into
+    benchmarks/results.md (marker block ``serving-disagg``)."""
+    start = "<!-- serving-disagg:start -->"
+    end = "<!-- serving-disagg:end -->"
+    m = records[0]["model"]
+    header = (
+        f"`python benchmarks/serve_bench.py --workload {workload} "
+        f"--disagg {args.disagg}"
+        + (f" --workers {args.workers}" if args.workers else "")
+        + f" --update-md` — hidden {m['hidden']}, layers {m['layers']}, "
+        f"{records[0]['n_requests']} reqs @ concurrency "
+        f"{records[0]['concurrency']} per replica, "
+        f"{records[0]['prefix_groups'] or 'auto'} prefix groups, block "
+        f"{records[0]['block_size']}, store {args.kv_store_mb} MiB "
+        f"({time.strftime('%Y-%m-%d')}). The baseline lane is the "
+        f"symmetric fleet with per-replica caches only; the store lanes "
+        f"share one digest-addressed KV block store; the disagg lane "
+        f"splits the fleet into prefill/decode roles and migrates "
+        f"finished prefills. Stream match is bit-exactness against a "
+        f"single undisturbed engine on the same trace.\n\n"
+    )
+    lines = [
+        "| Lane | roles | fleet hit | per-replica hit | store-hit tok "
+        "| migrations | migrated bytes | stream match |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        per = " / ".join(
+            f"{p['prefix_hit_rate']:.2f}" for p in r["per_replica"])
+        role = args.disagg if r["lane"] == "disagg" else "symmetric"
+        match = ("bit-exact" if r.get("disagg_token_match")
+                 else "-" if r.get("disagg_token_match") is None
+                 else "DIVERGED")
+        lines.append(
+            f"| {r['lane']} | {role} "
+            f"| {r['fleet_prefix_hit_rate']:.2f} | {per} "
+            f"| {r['store_hit_tokens']} | {r['migrations']} "
+            f"| {r['migrated_bytes']} | {match} |")
+    block = f"{start}\n{header}" + "\n".join(lines) + f"\n{end}"
+    section_head = "## Disaggregated serving"
+    with open(_RESULTS_MD) as f:
+        text = f.read()
+    if start in text:
+        text = text.split(start)[0] + block + text.split(end)[1]
+    elif section_head in text:
+        text = text.replace(f"{section_head}\n",
+                            f"{section_head}\n\n{block}\n", 1)
+    elif "\n## Cross-process serving" in text:
+        text = text.replace(
+            "\n## Cross-process serving",
+            f"\n{section_head}\n\n{block}\n\n## Cross-process serving", 1)
+    elif "\n## Multi-replica serving" in text:
+        text = text.replace(
+            "\n## Multi-replica serving",
+            f"\n{section_head}\n\n{block}\n\n## Multi-replica serving", 1)
+    else:
+        text += f"\n{section_head}\n\n{block}\n"
+    with open(_RESULTS_MD, "w") as f:
+        f.write(text)
+    print(f"wrote disaggregated serving table to {_RESULTS_MD}",
           file=sys.stderr)
 
 

@@ -219,4 +219,27 @@ python -m tpu_trainer.tools.analyze "$TP_OUT" \
   --compare "$TP_OUT" --tp-parity-tol 0.0 --reject-tol 0.0 \
   --rpc-overhead-tol 5.0 --queue-wait-tol 60.0
 
+# 14. Disaggregated prefill/decode under fire: a 1:2 role-split fleet
+#     (worker 0 prefills, workers 1-2 decode) sharing the digest-
+#     addressed KV store over the kv_put/kv_get verbs, and the PREFILL
+#     worker — the one holding streams mid-migration — is SIGKILL'd
+#     (TPU_TRAINER_FAULT_REPLICA=0 pins the target; the default picks
+#     the highest live rid, which would kill a decode replica instead).
+#     The bench gates the disagg lane set itself (fleet hit strictly
+#     above the per-replica baseline, >=1 migration, every store lane's
+#     streams bit-exact vs a single undisturbed engine, and the kill
+#     lane must observe a real worker death); the drain gate asserts
+#     conservation on the survivors. analyze then re-gates the fleet
+#     hit rate (absolute, self-compare) and migrated-stream parity
+#     categorically.
+DISAGG_OUT="$OUT/disagg_kill.jsonl"
+rm -f "$DISAGG_OUT"
+echo "== chaos: disagg_kill (prefill-role worker death mid-migration) =="
+TPU_TRAINER_FAULT_REPLICA=0 \
+python benchmarks/serve_bench.py --smoke --workload shared_prefix \
+  --disagg 1:2 --workers 3 --worker-kill 6 --out "$DISAGG_OUT"
+python -m tpu_trainer.tools.analyze "$DISAGG_OUT" \
+  --compare "$DISAGG_OUT" --reject-tol 0.0 --fleet-hit-tol 0.05 \
+  --queue-wait-tol 60.0
+
 echo "chaos: full matrix clean ($OUT)"

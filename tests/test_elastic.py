@@ -713,6 +713,7 @@ class TestElasticGrowBack:
             env_extra=env_extra,
             **kw)
 
+    @pytest.mark.slow  # ~64s: two full supervisor runs (cold + standby).
     def test_notice_drain_beats_deadline_and_standby_cuts_recovery(
             self, tiny_yaml, tmp_path):
         # A preemption notice at step 4 (rank 1, the default target) must

@@ -1,0 +1,420 @@
+"""Fleet KV store tests (ISSUE 20): digest-addressed tiered block
+store, KV-block wire codec, store-backed prefix fills, and
+prefill->decode migration.
+
+Tier-1 (this module is NOT in conftest's _SLOW_MODULES), all on CPU in
+deterministic ``time_mode="steps"``. The load-bearing assertions:
+
+- the KV-block wire codec round-trips every pool leaf BITWISE for f32
+  and int8 pools alike (int8 entries carry their scale leaves — the
+  bytes ARE the device values, so migration and store fills can never
+  perturb a stream);
+- a torn, oversized, or malformed block/frame raises ``FrameError`` —
+  poisoning only the connection, exactly like a torn JSON frame, never
+  the process;
+- the host tier is a byte-budgeted LRU: inserts evict oldest-first and
+  never exceed the budget, eviction spills to the disk tier when one
+  is configured, and a disk hit promotes back to host (exclusive
+  tiers, file removed) with the payload intact;
+- an engine admitting a prompt whose blocks only the STORE has seen
+  fills fresh device blocks from it and produces greedy streams
+  BIT-IDENTICAL to an undisturbed engine — fill-then-read is bitwise,
+  f32 and int8;
+- a role-split in-process fleet (prefill replica migrates finished
+  streams to decode replicas through the store) stays bit-identical to
+  a single undisturbed engine with chunked prefill and speculative
+  decode composed on top;
+- prompt digests are computed ONCE per request at submit (satellite:
+  router affinity, admission pricing, and store addressing all reuse
+  the cached chain).
+
+The chaos-lane versions of the migration drills (real worker
+processes, SIGKILL mid-migration) live in scripts/chaos.sh lane 14 and
+serve_bench ``--disagg --workers --worker-kill``.
+"""
+
+import socket
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_trainer.models.config import GPTConfig
+from tpu_trainer.models.gpt import GPT
+from tpu_trainer.serving import (
+    Request,
+    SamplingParams,
+    ServingEngine,
+    ServingFrontend,
+)
+from tpu_trainer.serving.kv_store import (
+    KVBlockStore,
+    MigrationPricer,
+    leaves_nbytes,
+)
+from tpu_trainer.serving.remote import (
+    FrameError,
+    MAX_FRAME_BYTES,
+    decode_kv_block,
+    encode_kv_block,
+    recv_binary_frame,
+    send_binary_frame,
+    send_frame,
+)
+
+
+CFG = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=64, dropout=0.0, attention_dropout=0.0,
+                dtype="float32", param_dtype="float32")
+BLOCK = 8
+ENGINE_KW = dict(block_size=BLOCK, attention="reference",
+                 prefix_cache=True, max_batch=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return GPT(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def _prefix_requests(n, prefix_len=2 * BLOCK, max_new=6, seed=0,
+                     mixed=False):
+    """Shared-prefix trace; a fresh RandomState per call so two calls
+    build byte-identical traces (the bit-identity tests compare a
+    front-end run against a separate single-engine run)."""
+    rs = np.random.RandomState(seed)
+    prefix = rs.randint(1, CFG.vocab_size, size=prefix_len).tolist()
+    reqs = []
+    for i in range(n):
+        tail = rs.randint(1, CFG.vocab_size, size=4 + (i % 3) * 5).tolist()
+        temp = 0.8 if (mixed and i % 2) else 0.0
+        reqs.append(Request(
+            rid=i, prompt=prefix + tail, max_new_tokens=max_new,
+            sampling=SamplingParams(temperature=temp, top_p=0.9,
+                                    seed=100 + i)))
+    return reqs
+
+
+def _leaves(dtype=np.float32, seed=0):
+    """One block entry in pool-leaf shape: (block, kv_heads, head_dim)
+    K and V slices, plus f32 scale leaves for int8 pools."""
+    rs = np.random.RandomState(seed)
+    if dtype == np.int8:
+        return [
+            rs.randint(-128, 128, size=(BLOCK, 2, 16)).astype(np.int8),
+            rs.randint(-128, 128, size=(BLOCK, 2, 16)).astype(np.int8),
+            rs.standard_normal((BLOCK, 2, 1)).astype(np.float32),
+            rs.standard_normal((BLOCK, 2, 1)).astype(np.float32),
+        ]
+    return [rs.standard_normal((BLOCK, 2, 16)).astype(dtype),
+            rs.standard_normal((BLOCK, 2, 16)).astype(dtype)]
+
+
+# --- KV-block wire codec ---------------------------------------------------
+
+class TestKVCodec:
+    @pytest.mark.parametrize("dtype", [np.float32, np.int8])
+    def test_round_trip_is_bitwise_lossless(self, dtype):
+        leaves = _leaves(dtype)
+        back = decode_kv_block(encode_kv_block(leaves))
+        assert len(back) == len(leaves)
+        for a, b in zip(leaves, back):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            assert a.tobytes() == b.tobytes()
+
+    def test_round_trip_survives_the_binary_frame(self):
+        a, b = socket.socketpair()
+        try:
+            payload = encode_kv_block(_leaves(np.int8))
+            send_binary_frame(a, payload)
+            got = recv_binary_frame(b)
+            assert got == payload
+            for x, y in zip(_leaves(np.int8), decode_kv_block(got)):
+                assert x.tobytes() == y.tobytes()
+        finally:
+            a.close()
+            b.close()
+
+    def test_json_frame_where_binary_promised_is_poison(self):
+        a, b = socket.socketpair()
+        try:
+            send_frame(a, {"id": 1})
+            with pytest.raises(FrameError, match="expected a binary"):
+                recv_binary_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    @pytest.mark.parametrize("poison", [
+        struct.pack(">I", 0x8000_0000),                       # zero length
+        struct.pack(">I", (MAX_FRAME_BYTES + 1) | 0x8000_0000),  # oversized
+        struct.pack(">I", 100 | 0x8000_0000) + b"short",      # torn body
+    ])
+    def test_torn_binary_frame_raises_frame_error(self, poison):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(poison)
+            a.close()
+            with pytest.raises(FrameError):
+                recv_binary_frame(b)
+        finally:
+            b.close()
+
+    def test_malformed_block_payload_raises_frame_error(self):
+        good = encode_kv_block(_leaves())
+        for bad, why in [
+            (b"XXXX" + good[4:], "bad magic"),
+            (good[:-5], "truncated"),
+            (good + b"\x00\x00", "trailing"),
+        ]:
+            with pytest.raises(FrameError):
+                decode_kv_block(bad)
+        # A raw_len that disagrees with dtype*shape is refused before
+        # any array is built.
+        torn = bytearray(good)
+        # leaf header starts right after magic + u16 count; flip the
+        # dtype length byte to desynchronise every later field.
+        torn[6] ^= 0xFF
+        with pytest.raises(FrameError):
+            decode_kv_block(bytes(torn))
+
+    def test_oversized_block_refused_at_encode(self):
+        big = np.zeros(MAX_FRAME_BYTES + 8, np.uint8)
+        with pytest.raises(FrameError, match="exceeds max frame"):
+            encode_kv_block([big])
+
+
+# --- store tiers -----------------------------------------------------------
+
+class TestKVBlockStore:
+    def _entry(self, seed):
+        return [np.full((64,), seed, np.float32)]      # 256 B each
+
+    def test_host_lru_respects_byte_budget(self):
+        store = KVBlockStore(host_bytes=1024)          # room for 4 entries
+        for i in range(6):
+            assert store.put(bytes([i]) * 16, self._entry(i))
+        assert store.host_bytes_used <= 1024
+        assert len(store) == 4
+        # Oldest two evicted (no disk tier: gone for good).
+        assert not store.has(b"\x00" * 16) and not store.has(b"\x01" * 16)
+        assert store.get(b"\x05" * 16)[0] == "host"
+        assert store.counters["evictions_host"] == 2
+        assert store.counters["misses"] == 0
+
+    def test_get_touches_lru_order(self):
+        store = KVBlockStore(host_bytes=1024)
+        for i in range(4):
+            store.put(bytes([i]) * 16, self._entry(i))
+        store.get(b"\x00" * 16)                        # refresh the oldest
+        store.put(b"\x09" * 16, self._entry(9))        # evicts #1, not #0
+        assert store.has(b"\x00" * 16) and not store.has(b"\x01" * 16)
+
+    def test_duplicate_put_is_a_noop(self):
+        store = KVBlockStore(host_bytes=1024)
+        assert store.put(b"d" * 16, self._entry(1))
+        assert not store.put(b"d" * 16, self._entry(1))
+        assert store.counters["puts"] == 1
+        assert store.counters["dup_puts"] == 1
+
+    def test_eviction_spills_to_disk_and_hit_promotes(self, tmp_path):
+        store = KVBlockStore(host_bytes=1024, disk_dir=str(tmp_path))
+        entries = {bytes([i]) * 16: self._entry(i) for i in range(6)}
+        for dig, leaves in entries.items():
+            store.put(dig, leaves)
+        assert store.counters["spills_to_disk"] == 2
+        assert store.disk_bytes_used > 0
+        tier, leaves = store.get(b"\x00" * 16)         # spilled entry
+        assert tier == "disk"
+        assert leaves[0].tobytes() == entries[b"\x00" * 16][0].tobytes()
+        # Exclusive tiers: the hit promoted it to host, file removed.
+        assert b"\x00" * 16 not in store._disk
+        assert not list(tmp_path.glob("00000000000000000000000000000000.npz"))
+        assert store.get(b"\x00" * 16)[0] == "host"
+
+    def test_oversized_entry_skips_host_tier(self, tmp_path):
+        big = [np.zeros(1024, np.float32)]             # 4 KiB > 1 KiB budget
+        store = KVBlockStore(host_bytes=1024)
+        store.put(b"big!" * 4, big)
+        assert not store.has(b"big!" * 4)              # no disk: dropped
+        store = KVBlockStore(host_bytes=1024, disk_dir=str(tmp_path))
+        store.put(b"big!" * 4, big)
+        assert store.get(b"big!" * 4) is not None
+        assert store.host_bytes_used <= 1024
+
+    def test_entry_nbytes_and_new_digest_feed(self):
+        store = KVBlockStore(host_bytes=1 << 20)
+        leaves = self._entry(3)
+        store.put(b"n" * 16, leaves)
+        assert store.entry_nbytes(b"n" * 16) == leaves_nbytes(leaves)
+        assert store.entry_nbytes(b"?" * 16) is None
+        assert store.drain_new_digests() == [b"n" * 16]
+        assert store.drain_new_digests() == []
+
+    def test_new_digest_feed_is_bounded_without_a_drain(self):
+        store = KVBlockStore(host_bytes=64 << 20)
+        one = [np.zeros(1, np.int8)]
+        for i in range(4200):
+            store.put(i.to_bytes(2, "big"), one)
+        assert len(store._new) == 4096                 # standalone engines
+        assert len(store.drain_new_digests()) == 4096
+
+
+class TestMigrationPricer:
+    def test_transfer_wins_when_links_beat_recompute(self):
+        p = MigrationPricer(flops_per_token=1e9, device_flops=1e12,
+                            link_bytes_per_s=1e10)
+        # 1k tokens: ~1ms of FLOPs + dispatch; 1 MB moves in 0.1ms.
+        assert p.prefers_transfer(tokens=1024, nbytes=1 << 20)
+        # A huge payload for a trivial recompute goes the other way.
+        assert not p.prefers_transfer(tokens=8, nbytes=1 << 30)
+
+    def test_dispatch_overhead_prices_tiny_models_sanely(self):
+        p = MigrationPricer(flops_per_token=1e3, device_flops=1e12,
+                            link_bytes_per_s=1e9)
+        # The FLOP term alone would claim femtoseconds; the dispatch
+        # floor keeps small transfers preferable anyway.
+        assert p.recompute_s(64) >= p.dispatch_overhead_s
+        assert p.prefers_transfer(tokens=64, nbytes=100_000)
+
+
+# --- store-backed engine fills --------------------------------------------
+
+class TestStoreBackedEngine:
+    # int8 rides the slow lane: the codec tests pin int8 bitwise cheaply
+    # and the @slow composed-migration test drives int8 through the
+    # store end-to-end; tier-1 keeps the f32 engine round trip.
+    @pytest.mark.parametrize("kv_int8", [
+        False, pytest.param(True, marks=pytest.mark.slow)])
+    def test_fill_then_read_streams_bit_identical(self, params, kv_int8):
+        reqs = lambda: _prefix_requests(6)             # noqa: E731
+        ref_eng = ServingEngine(params, CFG, kv_int8=kv_int8, **ENGINE_KW)
+        want = {r.rid: list(r.generated)
+                for r in ref_eng.run(reqs(), time_mode="steps")}
+
+        store = KVBlockStore(host_bytes=32 << 20)
+        warm = ServingEngine(params, CFG, kv_int8=kv_int8,
+                             kv_store=store, **ENGINE_KW)
+        warm.run(reqs(), time_mode="steps")
+        assert store.counters["puts"] > 0              # prefill published
+
+        # A COLD engine sharing only the store: its device cache has
+        # never seen these blocks, so every prefix hit is a store fill.
+        cold = ServingEngine(params, CFG, kv_int8=kv_int8,
+                             kv_store=store, **ENGINE_KW)
+        fin = cold.run(reqs(), time_mode="steps")
+        assert {r.rid: list(r.generated) for r in fin} == want
+        s = cold.summary()
+        assert s["store_hit_tokens"] > 0
+        assert store.counters["hits_host"] > 0
+
+    def test_store_fill_counts_into_prefix_hit_tokens(self, params):
+        store = KVBlockStore(host_bytes=32 << 20)
+        ServingEngine(params, CFG, kv_store=store,
+                      **ENGINE_KW).run(_prefix_requests(4),
+                                       time_mode="steps")
+        cold = ServingEngine(params, CFG, kv_store=store, **ENGINE_KW)
+        fin = cold.run(_prefix_requests(4), time_mode="steps")
+        # The shared 2-block prefix was admitted from the store, so the
+        # requests themselves saw it as a prefix hit (admission skipped
+        # that prefill work).
+        assert max(r.prefix_hit_tokens for r in fin) >= 2 * BLOCK
+
+
+# --- disaggregated migration (in-process) ----------------------------------
+
+class TestDisaggMigration:
+    def _fe(self, params, **kw):
+        kw.setdefault("replicas", 2)
+        kw.setdefault("replica_roles", ["prefill", "decode"])
+        kw.setdefault("routing", "affinity")
+        kw.setdefault("time_mode", "steps")
+        kw.setdefault("kv_store_bytes", 32 << 20)
+        for k, v in ENGINE_KW.items():
+            kw.setdefault(k, v)
+        return ServingFrontend(params, CFG, **kw)
+
+    @pytest.mark.slow  # ~14s/param: two engines + a two-replica fleet.
+    @pytest.mark.parametrize("kv_int8", [False, True])
+    def test_migrated_streams_bit_identical_composed(self, params, kv_int8):
+        """Chunked prefill + ngram speculative decode + (optionally)
+        int8 KV, THROUGH a prefill->decode migration: the moved blocks
+        and raw tail must reproduce the single-engine streams exactly —
+        greedy and sampled alike (sampling is (seed, token_index)-keyed,
+        so any cache perturbation would surface immediately)."""
+        extra = dict(kv_int8=kv_int8, prefill_chunk_tokens=4,
+                     spec="ngram", spec_k=2)
+        eng = ServingEngine(params, CFG, **ENGINE_KW, **extra)
+        want = {r.rid: list(r.generated)
+                for r in eng.run(_prefix_requests(6, mixed=True),
+                                 time_mode="steps")}
+
+        fe = self._fe(params, **extra)
+        fin = fe.run(_prefix_requests(6, mixed=True))
+        assert {r.rid: list(r.generated) for r in fin} == want
+        s = fe.summary()
+        assert s["migrations"] >= 1
+        assert s["finished"] == s["accepted"] == len(fin)  # conservation
+        roles = [p.get("role") for p in s["per_replica"]]
+        assert roles == ["prefill", "decode"]
+
+    def test_prefill_role_stops_at_first_token(self, params):
+        fe = self._fe(params)
+        fin = fe.run(_prefix_requests(6))
+        s = fe.summary()
+        pre, dec = s["per_replica"]
+        # The prefill replica prefills (and may emit first tokens) but
+        # finishes nothing — every stream completes on the decode tier.
+        assert pre["finished"] == 0
+        assert dec["finished"] == len(fin)
+        assert s["migrations"] == len(fin)
+        assert s["migrated_bytes"] > 0
+
+    def test_fleet_hit_rate_reported_and_store_shared(self, params):
+        fe = self._fe(params)
+        fe.run(_prefix_requests(8))
+        s = fe.summary()
+        assert 0.0 <= s["fleet_prefix_hit_rate"] <= 1.0
+        # The shared store object saw real traffic from the fleet.
+        assert s["kv_store_puts"] > 0
+        assert s["store_hit_tokens_host"] >= 0
+
+    def test_roles_validated(self, params):
+        with pytest.raises(ValueError, match="decode"):
+            self._fe(params, replica_roles=["prefill", "prefill"])
+        with pytest.raises(ValueError, match="prefill | decode"):
+            self._fe(params, replica_roles=["prefil", "decode"])
+
+
+# --- digest hashed once per request ---------------------------------------
+
+class TestHashOnce:
+    def test_digests_computed_once_at_submit_and_reused(self, params,
+                                                        monkeypatch):
+        import tpu_trainer.serving.frontend as fe_mod
+        calls = []
+        real = fe_mod.chained_block_digests
+
+        def counting(tokens, block_size):
+            calls.append(len(tokens))
+            return real(tokens, block_size)
+
+        monkeypatch.setattr(fe_mod, "chained_block_digests", counting)
+        fe = ServingFrontend(params, CFG, replicas=2, routing="affinity",
+                             time_mode="steps", kv_store_bytes=8 << 20,
+                             **ENGINE_KW)
+        reqs = _prefix_requests(5)
+        for r in reqs:
+            fe.submit(r)
+            assert r._prompt_digests is not None       # cached at submit
+        cached = {r.rid: r._prompt_digests for r in reqs}
+        fe.drain()
+        # Router key, admission pricing, and store addressing all reused
+        # the one chain per request — and the in-process engine reused
+        # the very same list object instead of rehashing the prompt.
+        assert len(calls) == len(reqs)
+        for r in reqs:
+            assert r._prompt_digests is cached[r.rid]

@@ -54,6 +54,7 @@ import numpy as np
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.models.gpt import GPT, init_paged_cache
 from tpu_trainer.obs.metrics import NULL_REGISTRY
+from tpu_trainer.serving.kv_store import KVBlockStore, MigrationPricer
 from tpu_trainer.serving.paged_cache import PagedKVCache
 from tpu_trainer.serving.sampling import sample_tokens
 from tpu_trainer.serving.scheduler import Request, SamplingParams, Scheduler
@@ -72,6 +73,12 @@ def _bucket_pow2(n: int, lo: int = 8) -> int:
     while w < n:
         w *= 2
     return w
+
+
+# Device-cache leaves that hold per-block K/V payload (int8 pools add the
+# scale planes). Everything else in the cache pytree is scheduling state
+# re-broadcast from host mirrors each step.
+_POOL_LEAF_KEYS = ("pool_k", "pool_v", "scale_k", "scale_v")
 
 
 class ServingEngine:
@@ -107,6 +114,11 @@ class ServingEngine:
         mesh_tensor: Optional[int] = None,
         mesh_devices: Optional[Sequence[int]] = None,
         device_block_budget: Optional[int] = None,
+        kv_store: Optional[KVBlockStore] = None,
+        kv_store_bytes: Optional[int] = None,
+        kv_store_dir: Optional[str] = None,
+        kv_link_gbps: float = 16.0,
+        role: Optional[str] = None,
     ):
         if spec not in ("off", "ngram", "draft"):
             raise ValueError(f"spec={spec!r} (off | ngram | draft)")
@@ -151,9 +163,27 @@ class ServingEngine:
         self.eos_id = eos_id
         self.clock = clock
         self.prefix_cache = prefix_cache
+        # Fleet KV store (serving/kv_store.py): in-process replicas share
+        # ONE object via ``kv_store``; cross-process workers each build a
+        # local store from the scalar (wire-able) ``kv_store_bytes`` /
+        # ``kv_store_dir`` kwargs and synchronize over the kv_* RPC verbs.
+        self._owns_store = kv_store is None
+        if kv_store is None and (kv_store_bytes or kv_store_dir):
+            kv_store = KVBlockStore(
+                host_bytes=int(kv_store_bytes) if kv_store_bytes
+                else 64 << 20,
+                disk_dir=kv_store_dir)
+        self.kv_store = kv_store
+        self._pool_leaf_idx: Optional[List[int]] = None
         self.cache_state = PagedKVCache(
-            self.config, max_batch, prefix_cache=prefix_cache
+            self.config, max_batch, prefix_cache=prefix_cache,
+            kv_store=kv_store,
         )
+        if kv_store is not None:
+            self.cache_state.spill_fn = self._store_put_block
+            self.cache_state.fill_fn = self._store_fill_block
+            self.cache_state.raw_fill_fn = self.write_block
+            self.cache_state.pricer = self._build_pricer(kv_link_gbps)
         # Speculative decoding: resolve the proposer before the
         # scheduler so admission can budget for the draft window.
         proposer = spec_proposer
@@ -180,6 +210,9 @@ class ServingEngine:
             spec_reserve_tokens=(
                 spec_k + 1 if self.spec_decoder is not None else 0),
         )
+        self.role: Optional[str] = None
+        if role is not None:
+            self.set_role(role)
         # Observability (serving/tracing.py): per-rid span timelines in
         # this engine's clock domain, and wall-clock attribution for the
         # run loop. Both host-side only — they can never perturb the
@@ -287,6 +320,46 @@ class ServingEngine:
                   ).set_function(lambda: len(self.scheduler.running))
         reg.gauge("serve_outstanding_tokens", "Token-steps of work owed"
                   ).set_function(lambda: self.outstanding_tokens)
+        if self.kv_store is not None:
+            store, cs = self.kv_store, self.cache_state
+            kvb = reg.gauge("kv_store_bytes",
+                            "Fleet KV store payload bytes by tier",
+                            labelnames=("tier",))
+            kvb.labels(tier="host").set_function(
+                lambda: store.host_bytes_used)
+            kvb.labels(tier="disk").set_function(
+                lambda: store.disk_bytes_used)
+            kvh = reg.counter("kv_store_hits_total",
+                              "Store block hits by serving tier",
+                              labelnames=("tier",))
+            kvh.labels(tier="host").set_function(
+                lambda: store.counters["hits_host"])
+            kvh.labels(tier="disk").set_function(
+                lambda: store.counters["hits_disk"])
+            kvt = reg.counter("kv_store_hit_tokens_total",
+                              "Prompt tokens admitted from the store",
+                              labelnames=("tier",))
+            kvt.labels(tier="host").set_function(
+                lambda: cs.store_hit_tokens_host)
+            kvt.labels(tier="disk").set_function(
+                lambda: cs.store_hit_tokens_disk)
+            kve = reg.counter("kv_store_evictions_total",
+                              "Store entries evicted by tier",
+                              labelnames=("tier",))
+            kve.labels(tier="host").set_function(
+                lambda: store.counters["evictions_host"])
+            kve.labels(tier="disk").set_function(
+                lambda: store.counters["evictions_disk"])
+            reg.counter("kv_store_puts_total",
+                        "Blocks published into the store"
+                        ).set_function(lambda: store.counters["puts"])
+            reg.counter("kv_store_spills_total",
+                        "Evicted device blocks demoted into the store"
+                        ).set_function(lambda: cs.n_store_spills)
+            reg.counter("kv_store_migrated_tails_total",
+                        "Migrated raw tail blocks admitted"
+                        ).set_function(
+                            lambda: self.scheduler.n_migrated_tail_fills)
         if self.spec_decoder is not None:
             reg.counter("serve_spec_drafted_total", "Draft tokens proposed"
                         ).set_function(lambda: self.stats["spec_drafted"])
@@ -311,6 +384,16 @@ class ServingEngine:
         for k in self.scheduler.terminal_counts:
             self.scheduler.terminal_counts[k] = 0
         self.cache_state.n_prefix_evictions = 0
+        self.cache_state.n_store_spills = 0
+        self.cache_state.n_store_declined = 0
+        self.cache_state.store_hit_tokens_host = 0
+        self.cache_state.store_hit_tokens_disk = 0
+        self.scheduler.n_migrated_tail_fills = 0
+        self.scheduler.n_migration_declined = 0
+        if self.kv_store is not None and self._owns_store:
+            # A shared (front-end-owned) store keeps its fleet counters;
+            # a private one resets with the engine.
+            self.kv_store.reset_stats()
         self.wall_elapsed = 0.0
         self._deadline_margins = []
         if self.spec_decoder is not None:
@@ -639,7 +722,149 @@ class ServingEngine:
         blocks = cs.slot_blocks(r.slot)
         for i in range(r._blocks_registered, done):
             cs.prefix_register(r._prompt_digests[i], blocks[i])
+            if self.kv_store is not None:
+                # Write-through to the fleet tier: a block computed on
+                # ANY replica is addressable fleet-wide the moment it is
+                # published, not only when local eviction spills it.
+                self._store_put_block(r._prompt_digests[i], blocks[i])
         r._blocks_registered = done
+
+    # -- fleet KV store: device block I/O + migration ----------------------
+
+    def _build_pricer(self, link_gbps: float) -> MigrationPricer:
+        from tpu_trainer.utils.logging import (
+            device_peak_flops,
+            flops_per_token,
+        )
+
+        try:
+            peak = float(device_peak_flops())
+        except Exception:
+            peak = 1e12
+        # flops_per_token counts fwd+bwd (6N + attn); the recompute a
+        # migration avoids is one forward pass — a third of that.
+        fwd = flops_per_token(self.config) / 3.0
+        return MigrationPricer(
+            flops_per_token=fwd, device_flops=peak,
+            link_bytes_per_s=float(link_gbps) * 1e9)
+
+    def _pool_leaves(self) -> Tuple[List, List[int], object]:
+        """Flatten the device cache; memoize which leaf positions are
+        block pools (the structure is static — steps replace values, not
+        shape). Returns (all leaves, pool leaf indices, treedef)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            self.device_cache)
+        if self._pool_leaf_idx is None:
+            self._pool_leaf_idx = [
+                i for i, (path, _) in enumerate(flat)
+                if getattr(path[-1], "key", None) in _POOL_LEAF_KEYS]
+        return [leaf for _, leaf in flat], self._pool_leaf_idx, treedef
+
+    @staticmethod
+    def _block_index(leaf, block_id: int) -> tuple:
+        """Index tuple selecting one block from a pool leaf. Per-layer
+        pools are rank 4 ``[nblk, bsz, kvh, d|nbq]``; the scanned model
+        stacks layers in front (rank 5, block axis 1)."""
+        return (slice(None),) * (leaf.ndim - 4) + (block_id,)
+
+    def read_block(self, block_id: int) -> List[np.ndarray]:
+        """One block's K/V payload as host arrays, one per pool leaf in
+        tree-flatten order — the store/wire entry format. Engines built
+        from the same config flatten identically, so entries round-trip
+        across the fleet."""
+        leaves, idx, _ = self._pool_leaves()
+        return [np.asarray(leaves[i][self._block_index(leaves[i], block_id)])
+                for i in idx]
+
+    def write_block(self, block_id: int, payload: List[np.ndarray]) -> bool:
+        """Write a store/migration entry into device block ``block_id``.
+        False (device untouched) on any layout mismatch — a store shared
+        across differently configured engines degrades to recompute
+        instead of corrupting a pool."""
+        leaves, idx, treedef = self._pool_leaves()
+        if len(payload) != len(idx):
+            return False
+        for j, arr in zip(idx, payload):
+            cur = leaves[j]
+            ax = cur.ndim - 4
+            want = tuple(cur.shape[:ax]) + tuple(cur.shape[ax + 1:])
+            if (tuple(arr.shape) != want
+                    or np.dtype(arr.dtype) != np.dtype(cur.dtype)):
+                return False
+        for j, arr in zip(idx, payload):
+            leaves[j] = leaves[j].at[
+                self._block_index(leaves[j], block_id)].set(jnp.asarray(arr))
+        self.device_cache = jax.tree_util.tree_unflatten(treedef, leaves)
+        return True
+
+    def _store_put_block(self, digest: bytes, block_id: int) -> bool:
+        """Publish one device block into the fleet store (idempotent per
+        digest). Doubles as the cache's eviction spill hook."""
+        if self.kv_store is None or self.device_cache is None:
+            return False
+        if self.kv_store.has(digest):
+            return False
+        return self.kv_store.put(digest, self.read_block(block_id))
+
+    def _store_fill_block(self, digest: bytes, block_id: int):
+        """The cache's store fall-through hook: fetch ``digest`` and fill
+        a freshly allocated device block. Returns the serving tier
+        ("host"/"disk") or None on miss/mismatch."""
+        got = self.kv_store.get(digest)
+        if got is None:
+            return None
+        tier, payload = got
+        return tier if self.write_block(block_id, payload) else None
+
+    def set_role(self, role: Optional[str]) -> None:
+        """Assign this replica's disaggregation role. ``"prefill"``
+        disables decode scheduling: requests run to the end of prefill
+        (sampling their first token) and then idle until the front-end
+        extracts them for migration. ``"decode"``/None is a full
+        engine."""
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(f"role={role!r} (prefill | decode | None)")
+        self.role = role
+        self.scheduler.decode_enabled = role != "prefill"
+
+    def migratable_rids(self) -> List[int]:
+        """Requests a prefill-role replica has carried as far as it can:
+        prefill complete and the first token sampled — exactly the state
+        a decode replica needs to continue the stream."""
+        return [r.rid for r in self.scheduler.running
+                if r.status == "running" and not r.prefilling()
+                and r.generated]
+
+    def extract_request(self, rid: int):
+        """Migration harvest + handoff: publish the request's full
+        prompt blocks to the fleet store (digest-addressed), read its
+        sub-block tail raw, then strip it out of the scheduler in
+        fresh-waiting state. Returns ``(request, payload)`` with payload
+        ``{"tail_ntok", "leaves"}``, or None if ``rid`` is not in a
+        migratable state. Re-admission elsewhere matches the full blocks
+        through the store, fills the tail raw, and resumes sampling at
+        the same (seed, token_index) — bit-identical to never moving."""
+        req = next(
+            (r for r in self.scheduler.running if r.rid == rid), None)
+        if req is None or req.prefilling() or not req.generated:
+            return None
+        cs = self.cache_state
+        payload = {"tail_ntok": 0, "leaves": None}
+        if self.kv_store is not None:
+            if req._prompt_digests is None:
+                req._prompt_digests = cs.block_digests(req.prompt)
+            blocks = cs.slot_blocks(req.slot)
+            full = len(req.prompt) // cs.block_size
+            for i in range(min(full, len(blocks))):
+                self._store_put_block(req._prompt_digests[i], blocks[i])
+            tail = len(req.prompt) - full * cs.block_size
+            if tail and full < len(blocks):
+                payload = {"tail_ntok": tail,
+                           "leaves": self.read_block(blocks[full])}
+        if self.spec_decoder is not None:
+            self.spec_decoder.forget(req)
+        self.scheduler.extract(req)
+        return req, payload
 
     def _now(self) -> float:
         if self._t0 is None:
@@ -775,6 +1000,18 @@ class ServingEngine:
             / max(1, self.scheduler.prompt_tokens)
         )
         s["prefix_evictions"] = self.cache_state.n_prefix_evictions
+        if self.kv_store is not None:
+            cs = self.cache_state
+            s["store_hit_tokens_host"] = cs.store_hit_tokens_host
+            s["store_hit_tokens_disk"] = cs.store_hit_tokens_disk
+            s["store_hit_tokens"] = (
+                cs.store_hit_tokens_host + cs.store_hit_tokens_disk)
+            s["store_spills"] = cs.n_store_spills
+            s["store_declined"] = cs.n_store_declined
+            s["migrated_tail_fills"] = self.scheduler.n_migrated_tail_fills
+            s["migration_declined"] = self.scheduler.n_migration_declined
+            for k, v in self.kv_store.stats().items():
+                s[f"kv_store_{k}"] = v
         s.update(self.cache_state.fragmentation())
         s.update(self.scheduler.pool_shard_stats())
         s["queue_depth"] = self.queue_depth

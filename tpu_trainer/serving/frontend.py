@@ -100,6 +100,7 @@ import numpy as np
 from tpu_trainer.models.config import GPTConfig
 from tpu_trainer.obs.metrics import NULL_REGISTRY, MetricsRegistry
 from tpu_trainer.serving.engine import ServingEngine
+from tpu_trainer.serving.kv_store import KVBlockStore, leaves_nbytes
 from tpu_trainer.serving.paged_cache import chained_block_digests
 from tpu_trainer.serving.remote import ReplicaDied
 from tpu_trainer.serving.scheduler import Request
@@ -140,12 +141,15 @@ class LocalReplica:
     def __init__(self, engine: ServingEngine):
         self.engine = engine
 
-    def submit(self, req: Request, trace: Optional[List[dict]] = None) -> None:
+    def submit(self, req: Request, trace: Optional[List[dict]] = None,
+               migration: Optional[dict] = None) -> None:
         if trace:
             # Same contract as RemoteReplica: front-door span context
             # merges into the engine's tracer (non-pending — never
             # echoed back to the front-end that already holds it).
             self.engine.tracer.ingest(trace)
+        if migration is not None:
+            req._kv_migration = migration
         self.engine.scheduler.add(req)
 
     def step(self) -> List[Request]:
@@ -187,6 +191,17 @@ class LocalReplica:
     def release(self) -> None:
         self.engine.device_cache = None   # drop the KV pools
 
+    # -- disaggregation surface (mirrors RemoteReplica's) ------------------
+
+    def set_role(self, role: Optional[str]) -> None:
+        self.engine.set_role(role)
+
+    def migratable_rids(self) -> List[int]:
+        return self.engine.migratable_rids()
+
+    def extract(self, rid: int):
+        return self.engine.extract_request(rid)
+
     @property
     def block_size(self) -> int:
         return self.engine.cache_state.block_size
@@ -206,6 +221,14 @@ class LocalReplica:
     @property
     def n_preemptions(self) -> int:
         return self.engine.scheduler.n_preemptions
+
+    @property
+    def store_hit_tokens_host(self) -> int:
+        return int(self.engine.cache_state.store_hit_tokens_host)
+
+    @property
+    def store_hit_tokens_disk(self) -> int:
+        return int(self.engine.cache_state.store_hit_tokens_disk)
 
 
 @dataclasses.dataclass
@@ -245,6 +268,7 @@ class ServingFrontend:
         seed: int = 0,
         replica_factory=None,
         replica_device_sets=None,
+        replica_roles: Optional[Sequence[str]] = None,
         trace: bool = True,
         ts_interval: int = 32,
         incident_dir: Optional[str] = None,
@@ -290,6 +314,37 @@ class ServingFrontend:
         # RPC worker spec serializes it too).
         engine_kwargs.setdefault("trace", trace)
         self._engine_kwargs = engine_kwargs
+        # Disaggregated prefill/decode: replica ``rid`` takes role
+        # ``replica_roles[rid % len]``. Prefill replicas run chunked
+        # prefill + the first token only; the front-end then migrates
+        # the finished KV (digest-addressed full blocks via the store,
+        # raw tail) to a rendezvous-routed decode replica. Roles are a
+        # performance shape, never a correctness dependency — any
+        # request can fall back to plain re-prefill anywhere.
+        self.replica_roles = list(replica_roles) if replica_roles else None
+        if self.replica_roles:
+            for r in self.replica_roles:
+                if r not in ("prefill", "decode"):
+                    raise ValueError(
+                        f"replica_roles entry {r!r} (prefill | decode)")
+            if "decode" not in self.replica_roles:
+                raise ValueError("replica_roles needs a decode replica")
+        self._role: Dict[int, str] = {}
+        # Fleet-wide KV block store. In-process fleets share ONE store
+        # object (a prefix prefilled on any replica is a store hit on
+        # every other); RPC fleets give each worker a local store
+        # (kv_store_bytes in engine kwargs) synchronized over the
+        # kv_put/kv_get verbs, with a digest->holder catalog fed by
+        # load-snapshot deltas.
+        self.kv_store: Optional[KVBlockStore] = None
+        if self._replica_factory is None and (
+                engine_kwargs.get("kv_store_bytes")
+                or engine_kwargs.get("kv_store_dir")):
+            self.kv_store = KVBlockStore(
+                host_bytes=int(engine_kwargs.get("kv_store_bytes")
+                               or (64 << 20)),
+                disk_dir=engine_kwargs.get("kv_store_dir"))
+        self._kv_catalog: Dict[bytes, int] = {}
         # Mesh-aware replica placement: one replica = one mesh. Each
         # entry is a device-id list; replica ``rid`` takes entry
         # ``rid % len`` so a fleet carves the host's devices into
@@ -336,6 +391,8 @@ class ServingFrontend:
             "failover_events": 0, "failed_over_requests": 0,
             "worker_deaths": 0,
             "grows": 0, "shrinks": 0, "retired_replicas": 0,
+            "migrations": 0, "migrated_bytes": 0,
+            "migration_pushed_blocks": 0, "store_synced_blocks": 0,
             "imbalance_sum": 0.0, "imbalance_samples": 0,
             "imbalance_max": 0.0,
         }
@@ -410,6 +467,40 @@ class ServingFrontend:
                       - self.stats["finished"] - self.stats["cancelled"]
                       - self.stats["deadline_exceeded"]
                       - self.stats["failed"])
+        # Fleet store + disaggregation mirrors. Named frontend_kv_* (NOT
+        # kv_store_* — those are the per-engine families that arrive via
+        # pull_metrics with replica labels; re-registering them here
+        # label-free would conflict in the merge).
+        kvb = reg.gauge("frontend_kv_store_bytes",
+                        "Shared fleet KV store bytes by tier",
+                        labelnames=("tier",))
+        kvb.labels(tier="host").set_function(
+            lambda: self.kv_store.host_bytes_used
+            if self.kv_store is not None else 0)
+        kvb.labels(tier="disk").set_function(
+            lambda: self.kv_store.disk_bytes_used
+            if self.kv_store is not None else 0)
+        kvh = reg.counter("frontend_kv_store_hit_tokens_total",
+                          "Fleet prefill tokens skipped via store hits",
+                          labelnames=("tier",))
+        kvh.labels(tier="host").set_function(
+            lambda: sum(getattr(h.engine, "store_hit_tokens_host", 0)
+                        for h in self._replicas))
+        kvh.labels(tier="disk").set_function(
+            lambda: sum(getattr(h.engine, "store_hit_tokens_disk", 0)
+                        for h in self._replicas))
+        for name, key, help_ in (
+                ("frontend_kv_migrations_total", "migrations",
+                 "Requests migrated prefill->decode"),
+                ("frontend_kv_migrated_bytes_total", "migrated_bytes",
+                 "KV bytes moved by migration (blocks + raw tails)"),
+                ("frontend_kv_pushed_blocks_total",
+                 "migration_pushed_blocks",
+                 "Store blocks pushed to decode workers for migration"),
+                ("frontend_kv_synced_blocks_total", "store_synced_blocks",
+                 "Store blocks pushed at submit to symmetric workers")):
+            reg.counter(name, help_).set_function(
+                lambda k=key: self.stats[k])
 
     def ready(self) -> bool:
         """Readiness for /healthz: at least one live replica. Flips
@@ -426,7 +517,7 @@ class ServingFrontend:
             k: v for k, v in self.summary().items() if k != "per_replica"}
         out["replicas"] = [
             {"replica": h.rid, "alive": h.alive, "draining": h.draining,
-             "finished": h.finished}
+             "role": self._role.get(h.rid), "finished": h.finished}
             for h in self._replicas]
         for h, rec in zip(self._replicas, out["replicas"]):
             if h.alive and isinstance(h.engine, LocalReplica):
@@ -473,6 +564,11 @@ class ServingFrontend:
             if self._replica_device_sets:
                 dsets = self._replica_device_sets
                 kw["mesh_devices"] = dsets[rid % len(dsets)]
+            if self.kv_store is not None:
+                # Every in-process engine shares the front-end's one
+                # store object (kv_store wins over kv_store_bytes/_dir
+                # inside the engine) — "cached anywhere" IS the tier.
+                kw["kv_store"] = self.kv_store
             if self._metrics_on:
                 # Per-engine registry, merged into ours label-wise on
                 # each pull — the same shape as a worker process's.
@@ -484,6 +580,16 @@ class ServingFrontend:
         h = _Replica(rid=rid, engine=rep)
         self._next_rid += 1
         self._replicas.append(h)
+        if self.replica_roles:
+            role = self.replica_roles[rid % len(self.replica_roles)]
+            self._role[rid] = role
+            set_role = getattr(rep, "set_role", None)
+            if set_role is not None:
+                set_role(role)
+            elif role == "prefill":
+                raise ValueError(
+                    "replica adapter has no set_role surface for a "
+                    "prefill-role replica")
         return h
 
     def _live(self, *, routable: bool = False) -> List[_Replica]:
@@ -599,17 +705,32 @@ class ServingFrontend:
 
     # -- routing -----------------------------------------------------------
 
-    def _affinity_key(self, prompt: List[int]) -> Optional[bytes]:
+    def _prompt_digests(self, req: Request) -> List[bytes]:
+        """The request's chained block digests, hashed ONCE at first use
+        and cached on the request — the router key, replica admission
+        (``Scheduler._admit``), store addressing, and migration all read
+        this one list (cross-process too: it rides the request wire
+        codec)."""
+        if req._prompt_digests is None:
+            req._prompt_digests = chained_block_digests(
+                req.prompt, self.block_size)
+        return req._prompt_digests
+
+    def _affinity_key(self, req) -> Optional[bytes]:
         """Chained digest of the prompt's leading full blocks (capped at
         ``affinity_blocks`` — coarse on purpose: requests sharing a
         system prefix but diverging later must still share a key), or
-        None when the prompt has no full block (cold)."""
-        n = min(len(prompt) // self.block_size, self.affinity_blocks)
+        None when the prompt has no full block (cold). Accepts a
+        ``Request`` (digests cached on the request, hashed once) or a
+        raw token sequence for out-of-band probes."""
+        if isinstance(req, Request):
+            digs = self._prompt_digests(req)
+        else:
+            digs = chained_block_digests(req, self.block_size)
+        n = min(len(digs), self.affinity_blocks)
         if n == 0:
             return None
-        digs = chained_block_digests(
-            prompt[:n * self.block_size], self.block_size)
-        return digs[-1]
+        return digs[n - 1]
 
     @staticmethod
     def _rendezvous(key: bytes, cands: List[_Replica]) -> _Replica:
@@ -635,11 +756,19 @@ class ServingFrontend:
         live = self._live(routable=True)
         if not live:
             raise RuntimeError("no live replicas to route to")
+        if self.replica_roles:
+            # Disaggregated fleets admit at the prefill tier; when no
+            # prefill replica survives, the decode fleet admits directly
+            # and simply recomputes (roles never gate correctness).
+            pre = [h for h in live
+                   if self._role.get(h.rid) == "prefill"]
+            if pre:
+                live = pre
         if self.routing == "random":
             return live[int(self._rs.randint(len(live)))], "random"
         if self.routing == "least_loaded":
             return min(live, key=self._load), "least_loaded"
-        key = self._affinity_key(req.prompt)
+        key = self._affinity_key(req)
         if key is None:
             return min(live, key=self._load), "cold"
         target = self._rendezvous(key, live)
@@ -649,6 +778,20 @@ class ServingFrontend:
                 - least.engine.outstanding_tokens > self.spill_tokens):
             return least, "spill"
         return target, "affinity"
+
+    def _route_decode(self, req: Request) -> Optional[_Replica]:
+        """Pick the decode replica a migrated request lands on:
+        rendezvous over the decode tier on the same affinity key (so
+        shared-prefix streams co-locate and re-share store fills), cold
+        prompts go least-loaded. None when no decode replica is live."""
+        live = [h for h in self._live(routable=True)
+                if self._role.get(h.rid) != "prefill"]
+        if not live:
+            return None
+        key = self._affinity_key(req)
+        if key is None:
+            return min(live, key=self._load)
+        return self._rendezvous(key, live)
 
     # -- admission ---------------------------------------------------------
 
@@ -685,6 +828,7 @@ class ServingFrontend:
                 oldest_wait=target.engine.oldest_wait_age(now))
             self.submit_results[req.rid] = res
             return res
+        self._sync_store_to(target, req)
         self._enqueue(target, req, routed)
         res = SubmitResult(
             accepted=True, replica=target.rid, routed=routed,
@@ -693,15 +837,57 @@ class ServingFrontend:
         self.submit_results[req.rid] = res
         return res
 
-    def _enqueue(self, h: _Replica, req: Request, routed: str) -> None:
+    def _enqueue(self, h: _Replica, req: Request, routed: str,
+                 migration: Optional[dict] = None) -> None:
         self._emit(req.rid, "routed", replica=h.rid, policy=routed)
         ctx = self.tracer.events(req.rid) if self.tracer.enabled else None
-        h.engine.submit(req, trace=ctx)
+        if migration is not None:
+            h.engine.submit(req, trace=ctx, migration=migration)
+        else:
+            h.engine.submit(req, trace=ctx)
         h.routed[routed] = h.routed.get(routed, 0) + 1
         key = f"routed_{routed}"
         self.stats[key] = self.stats.get(key, 0) + 1
-        if routed != "failover":
+        # failover moves an accepted request; migrate re-admits one —
+        # neither is a NEW acceptance.
+        if routed not in ("failover", "migrate"):
             self.stats["accepted"] += 1
+
+    def _sync_store_to(self, target: _Replica, req: Request) -> None:
+        """Symmetric RPC fleets only: before a remote replica admits,
+        push any leading prompt blocks the fleet has computed (per the
+        kv_new catalog) but the target's local store lacks. In-process
+        fleets get this for free from the one shared store object;
+        disaggregated fleets share through the migration path instead.
+        Opportunistic — a push failure just means recompute."""
+        if self.replica_roles or not self._kv_catalog:
+            return
+        if not hasattr(target.engine, "kv_put"):
+            return
+        digs = [d for d in self._prompt_digests(req)
+                if self._kv_catalog.get(d) not in (None, target.rid)]
+        if not digs:
+            return
+        try:
+            have = target.engine.kv_has(digs)
+            for dig, got in zip(digs, have):
+                if got:
+                    continue
+                holder = next(
+                    (hh for hh in self._replicas
+                     if hh.alive and hh.rid == self._kv_catalog[dig]
+                     and hasattr(hh.engine, "kv_get")), None)
+                if holder is None:
+                    continue
+                hit = holder.engine.kv_get(dig)
+                if hit is not None:
+                    target.engine.kv_put(dig, hit[1])
+                    self._kv_catalog[dig] = target.rid
+                    self.stats["store_synced_blocks"] += 1
+        except ReplicaDied:
+            # The dead side is settled by the next step/poll cycle; the
+            # request itself is unaffected (recompute is always correct).
+            pass
 
     # -- cancellation ------------------------------------------------------
 
@@ -901,6 +1087,8 @@ class ServingFrontend:
                     self._observe_deadline(r)
         self.stats["finished"] += len(finished)
         with self.ledger.track("host_sched"):
+            self._migrate_ready()
+            self._catalog_update()
             self._sample_load()
             if (self._metrics_on
                     and self._iters % self.metrics_pull_every == 0):
@@ -908,6 +1096,124 @@ class ServingFrontend:
         if self.ts_interval and self._iters % self.ts_interval == 0:
             self._emit_ts()
         return finished
+
+    # -- prefill -> decode migration ---------------------------------------
+
+    def _migrate_ready(self) -> None:
+        """Sweep prefill-role replicas for prefill-complete requests and
+        move each to the decode tier: full prompt blocks travel digest-
+        addressed through the store (shared object in-process, kv_put
+        pushes cross-process), the sub-block tail rides the submit as a
+        raw binary frame, and the decode replica admits with its cursor
+        already past everything transferred. Admission prices every
+        block against recompute — a declined transfer is recomputed,
+        never wrong."""
+        if not self.replica_roles:
+            return
+        for h in list(self._replicas):
+            if not h.alive or self._role.get(h.rid) != "prefill":
+                continue
+            try:
+                self._migrate_from(h)
+            except ReplicaDied:
+                # The prefill worker died mid-harvest (the chaos lane:
+                # SIGKILL mid-migration). Whatever it still held —
+                # extracted or not — fails over through the normal
+                # export path and re-prefills on the survivors.
+                self.stats["worker_deaths"] += 1
+                self.kill_replica(h.rid, reason="rpc_death")
+
+    def _migrate_from(self, h: _Replica) -> None:
+        for rid in list(h.engine.migratable_rids()):
+            out = h.engine.extract(rid)
+            if out is None:
+                continue
+            req, payload = out
+            payload = payload or {"tail_ntok": 0, "leaves": None}
+            target = self._route_decode(req)
+            if target is None:
+                # No decode replica left: demote this prefill replica
+                # and finish the stream in place — roles are a
+                # performance shape, never a correctness dependency.
+                self._demote(h)
+                self._enqueue(h, req, "migrate", migration=payload)
+                continue
+            digs = self._prompt_digests(req)
+            nbytes = (leaves_nbytes(payload["leaves"])
+                      if payload.get("leaves") is not None else 0)
+            if self.kv_store is not None:
+                for dig in digs:
+                    nbytes += int(self.kv_store.entry_nbytes(dig) or 0)
+            try:
+                nbytes += self._push_blocks(h, target, digs)
+                self._emit(req.rid, "migrated", src=h.rid,
+                           dst=target.rid, nbytes=nbytes)
+                self._enqueue(target, req, "migrate", migration=payload)
+            except ReplicaDied:
+                # The DECODE side died mid-push/submit: settle it, then
+                # hand the request to whatever is left via the failover
+                # path (plain re-prefill — pushes are never load-bearing
+                # for correctness).
+                self.stats["worker_deaths"] += 1
+                self.kill_replica(target.rid, reason="rpc_death")
+                alt, _ = self._route(req)
+                self._enqueue(alt, req, "failover")
+                continue
+            self.stats["migrations"] += 1
+            self.stats["migrated_bytes"] += nbytes
+
+    def _push_blocks(self, src: _Replica, dst: _Replica,
+                     digs: List[bytes]) -> int:
+        """Cross-process block transfer for one migration: pull each
+        digest the target's store lacks from the source worker and push
+        it. Returns bytes pushed. Raises ``ReplicaDied`` only for the
+        DESTINATION; a source-side failure just truncates the pulls
+        (the target recomputes what never arrived)."""
+        if not digs or not hasattr(dst.engine, "kv_put"):
+            return 0
+        have = dst.engine.kv_has(digs)
+        pulled = []
+        try:
+            for dig, got in zip(digs, have):
+                if got:
+                    continue
+                hit = (src.engine.kv_get(dig)
+                       if hasattr(src.engine, "kv_get") else None)
+                if hit is not None:
+                    pulled.append((dig, hit[1]))
+        except ReplicaDied:
+            pass
+        nbytes = 0
+        for dig, leaves in pulled:
+            dst.engine.kv_put(dig, leaves)
+            self._kv_catalog[dig] = dst.rid
+            nbytes += leaves_nbytes(leaves)
+            self.stats["migration_pushed_blocks"] += 1
+        return nbytes
+
+    def _demote(self, h: _Replica) -> None:
+        self._role[h.rid] = "decode"
+        set_role = getattr(h.engine, "set_role", None)
+        if set_role is not None:
+            set_role(None)
+
+    def _catalog_update(self) -> None:
+        """Fold every replica's newly-stored digests (piggybacked on
+        load snapshots) into the digest->holder catalog — the submit-
+        time sync's map of who can serve a kv_get. The in-process shared
+        store needs no catalog; its delta is drained and dropped so the
+        list stays bounded."""
+        if self.kv_store is not None:
+            self.kv_store.drain_new_digests()
+            return
+        for h in self._replicas:
+            if not h.alive:
+                continue
+            drain = getattr(h.engine, "drain_new_digests", None)
+            if drain is None:
+                continue
+            for dig in drain():
+                self._kv_catalog[dig] = h.rid
 
     def _arm_net_fault(self, kind: str) -> None:
         """Arm a one-shot transport fault on one replica's next RPC.
@@ -1054,6 +1360,22 @@ class ServingFrontend:
         s["prompt_tokens"] = prompt
         s["prefix_hit_tokens"] = hit
         s["prefix_hit_rate"] = hit / max(1, prompt)
+        # Token-weighted across every replica, store-tier fills counted
+        # (admission folds store hits into prefix_hit_tokens) — THE
+        # fleet number the store exists to move: per-replica affinity
+        # can only reach its local ceiling; "cached anywhere, hit
+        # everywhere" pushes past it.
+        s["fleet_prefix_hit_rate"] = hit / max(1, prompt)
+        sh_host = sum(getattr(h.engine, "store_hit_tokens_host", 0)
+                      for h in self._replicas)
+        sh_disk = sum(getattr(h.engine, "store_hit_tokens_disk", 0)
+                      for h in self._replicas)
+        s["store_hit_tokens_host"] = int(sh_host)
+        s["store_hit_tokens_disk"] = int(sh_disk)
+        s["store_hit_tokens"] = int(sh_host + sh_disk)
+        if self.kv_store is not None:
+            for k, v in self.kv_store.stats().items():
+                s[f"kv_store_{k}"] = v
         s["generated_tokens"] = gen
         s["iters"] = self._iters
         if self.wall_elapsed:
@@ -1064,12 +1386,16 @@ class ServingFrontend:
                 "replica": h.rid,
                 "alive": h.alive,
                 "draining": h.draining,
+                "role": self._role.get(h.rid),
                 "finished": h.finished,
                 "routed": dict(h.routed),
                 "generated_tokens": h.engine.generated_tokens,
                 "prefix_hit_rate": (
                     h.engine.prefix_hit_tokens
                     / max(1, h.engine.prompt_tokens)),
+                "store_hit_tokens": int(
+                    getattr(h.engine, "store_hit_tokens_host", 0)
+                    + getattr(h.engine, "store_hit_tokens_disk", 0)),
                 "preemptions": h.engine.n_preemptions,
             }
             for h in self._replicas

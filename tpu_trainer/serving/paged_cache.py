@@ -132,7 +132,8 @@ class PagedKVCache:
     """Host mirrors (tables, lengths, offsets, pool, prefix index) for
     one engine's slot batch."""
 
-    def __init__(self, config, slots: int, *, prefix_cache: bool = False):
+    def __init__(self, config, slots: int, *, prefix_cache: bool = False,
+                 kv_store=None):
         if not config.decode_paged:
             raise ValueError("PagedKVCache needs config.decode_paged=True")
         self.config = config
@@ -148,6 +149,22 @@ class PagedKVCache:
         self.prefix_cache = prefix_cache
         self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
         self.n_prefix_evictions = 0
+        # Fleet tier behind the device pool (serving/kv_store.py).
+        # Device I/O is the owning engine's job — it installs the hooks:
+        # ``spill_fn(digest, bid) -> bool`` reads a device block into the
+        # store, ``fill_fn(digest, bid) -> tier|None`` writes store bytes
+        # into a device block, ``raw_fill_fn(bid, leaves) -> bool`` the
+        # same for a migrated raw tail, ``pricer`` the migration-vs-
+        # recompute admission gate (kv_store.MigrationPricer).
+        self.store = kv_store
+        self.spill_fn = None
+        self.fill_fn = None
+        self.raw_fill_fn = None
+        self.pricer = None
+        self.n_store_spills = 0
+        self.n_store_declined = 0      # store hits priced out of transfer
+        self.store_hit_tokens_host = 0
+        self.store_hit_tokens_disk = 0
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens``."""
@@ -210,25 +227,85 @@ class PagedKVCache:
         imply equal token prefixes up to and including block i."""
         return chained_block_digests(tokens, self.block_size)
 
-    def prefix_lookup(self, prompt: List[int]) -> Tuple[List[int], int]:
+    def prefix_lookup(self, prompt: List[int], *,
+                      digests: Optional[List[bytes]] = None,
+                      context_len: Optional[int] = None,
+                      ) -> Tuple[List[int], int]:
         """Longest indexed prefix of ``prompt``, as ``(block_ids,
         matched_tokens)``. The match is capped at the last full block
-        strictly inside the prompt (at least the final prompt token is
-        always prefilled — its logit seeds generation), which also makes
-        sharing copy-on-write by construction: the requester's first
-        write starts at a block boundary in a private block. Hits touch
-        the LRU order. Returns ``([], 0)`` when the index is off."""
+        strictly inside the context (at least one token is always fed —
+        its logit seeds/continues generation), which also makes sharing
+        copy-on-write by construction: the requester's first write
+        starts at a block boundary in a private block. Hits touch the
+        LRU order. Returns ``([], 0)`` when the index is off.
+
+        ``digests`` skips re-hashing when the caller already computed
+        the prompt's chained digests (cached on the ``Request`` at
+        submit). ``context_len`` widens the cap for requests resuming
+        with generated tokens (KV migration): with context past the
+        prompt, *every* full prompt block is matchable — the fed token
+        is a generated one.
+
+        A device-index miss falls through to the fleet store: a stored
+        digest is filled into a freshly allocated device block and
+        adopted into the index, so admission skips prefill for any
+        block the fleet has ever computed (subject to the migration
+        pricer preferring transfer over recompute)."""
         if not self.prefix_cache:
             return [], 0
-        k_max = max(0, (len(prompt) - 1) // self.block_size)
+        ctx = len(prompt) if context_len is None else context_len
+        k_max = max(0, min(len(prompt), ctx - 1) // self.block_size)
+        if digests is None:
+            digests = self.block_digests(prompt[:k_max * self.block_size])
         shared: List[int] = []
-        for dig in self.block_digests(prompt[:k_max * self.block_size]):
+        for i in range(min(k_max, len(digests))):
+            dig = digests[i]
             bid = self._prefix.get(dig)
+            if bid is None:
+                bid = self._store_fill(dig)
             if bid is None:
                 break
             self._prefix.move_to_end(dig)
             shared.append(bid)
         return shared, len(shared) * self.block_size
+
+    def _store_fill(self, dig: bytes) -> Optional[int]:
+        """Fleet-store fall-through for one missed digest: allocate a
+        device block, fill it from the store, adopt it into the prefix
+        index (the alloc reference becomes the index reference, so the
+        filled block is refcounted exactly like a locally computed
+        entry). None on store miss, pricer veto, or a dry pool."""
+        if self.store is None or self.fill_fn is None:
+            return None
+        if not self.store.has(dig):
+            return None
+        if self.pricer is not None:
+            nbytes = self.store.entry_nbytes(dig) or 0
+            if not self.pricer.prefers_transfer(self.block_size, nbytes):
+                self.n_store_declined += 1
+                return None
+        got = self.alloc_blocks(1)
+        if got is None:
+            return None
+        bid = got[0]
+        tier = self.fill_fn(dig, bid)
+        if tier is None:
+            self.pool.free([bid])
+            return None
+        self._prefix[dig] = bid
+        if tier == "disk":
+            self.store_hit_tokens_disk += self.block_size
+        else:
+            self.store_hit_tokens_host += self.block_size
+        return bid
+
+    def fill_raw(self, block_id: int, leaves) -> bool:
+        """Write a migrated raw (tail) block's leaves into a private
+        device block via the engine hook. False when no hook is
+        installed or the payload doesn't match the pool layout."""
+        if self.raw_fill_fn is None:
+            return False
+        return bool(self.raw_fill_fn(block_id, leaves))
 
     def prefix_register(self, digest: bytes, block_id: int) -> bool:
         """Publish a freshly filled full block under its digest. The
@@ -280,7 +357,10 @@ class PagedKVCache:
         refcount-1 entries; blocks shared with live requests are never
         reclaimed — until the free list covers ``n``. An evicted parent
         makes its still-indexed children unreachable (the chained digest
-        walk stops early); they age out of the LRU in turn."""
+        walk stops early); they age out of the LRU in turn. With a fleet
+        store attached, the victim's device bytes are spilled into the
+        store (digest-addressed, dedup'd) before the block is destroyed
+        — eviction demotes the block a tier instead of forgetting it."""
         while self.pool.free_blocks < n:
             victim = None
             for dig, bid in self._prefix.items():
@@ -290,6 +370,9 @@ class PagedKVCache:
             if victim is None:
                 return None
             bid = self._prefix.pop(victim)
+            if self.store is not None and self.spill_fn is not None:
+                if self.spill_fn(victim, bid):
+                    self.n_store_spills += 1
             self.pool.free([bid])
             self.n_prefix_evictions += 1
         return self.pool.alloc(n)

@@ -72,6 +72,14 @@ from tpu_trainer.utils.flight_recorder import read_heartbeat
 
 _HEADER = struct.Struct(">I")
 MAX_FRAME_BYTES = 1 << 26   # 64 MiB: a garbage length prefix must not OOM us
+# Length-prefix high bit marks a BINARY frame (raw bytes, no JSON): the
+# KV-block transport for the kv_put/kv_get verbs and migration tails.
+# Binary frames only ever follow a JSON frame that announced them
+# (``nframes``), so the two kinds never have to be disambiguated blind.
+_BINARY_BIT = 0x8000_0000
+# A JSON frame may announce at most this many attached binary frames —
+# a garbage ``nframes`` must not make the reactor read forever.
+MAX_ATTACHED_FRAMES = 64
 
 
 class FrameError(Exception):
@@ -127,7 +135,114 @@ def send_frame(sock: socket.socket, obj) -> None:
     sock.sendall(encode_frame(obj))
 
 
-def rpc(sock: socket.socket, req_id: int, method: str, params: dict):
+def send_binary_frame(sock: socket.socket, payload: bytes) -> None:
+    if not payload or len(payload) > MAX_FRAME_BYTES:
+        raise FrameError(f"binary frame of {len(payload)} bytes out of range")
+    sock.sendall(_HEADER.pack(len(payload) | _BINARY_BIT) + payload)
+
+
+def recv_binary_frame(sock: socket.socket) -> bytes:
+    """Read one binary frame (announced by the preceding JSON frame's
+    ``nframes``). Raises ``FrameError`` on a torn header/body, a JSON
+    frame where binary was promised, or a length outside (0, MAX]."""
+    hdr = _recv_exact(sock, _HEADER.size)
+    (length,) = _HEADER.unpack(hdr)
+    if not (length & _BINARY_BIT):
+        raise FrameError("expected a binary frame, got a JSON length")
+    n = length & ~_BINARY_BIT
+    if n == 0 or n > MAX_FRAME_BYTES:
+        raise FrameError(f"bad binary frame length {n}")
+    return _recv_exact(sock, n)
+
+
+# -- KV block wire codec ---------------------------------------------------
+
+# One KV block entry as a self-describing binary payload:
+#
+#     +-------+---------+--- per leaf, n_leaves times ------------------+
+#     | magic | n_leaves| dtype_len | dtype | ndim | dims... | raw_len  |
+#     | KVB1  | u16     | u8        | ascii | u8   | u32 each| u32 + raw|
+#     +-------+---------+-----------------------------------------------+
+#
+# Leaves are the pool slices of one block in tree-flatten order
+# (pool_k/pool_v, plus scale_k/scale_v for int8 pools) with dtype and
+# shape preserved exactly — the raw bytes ARE the device values, so a
+# round-trip is bitwise lossless for f32 and int8 alike. The numpy
+# import stays lazy: remote.py must stay importable jax/numpy-free on
+# the supervisor side.
+
+KV_MAGIC = b"KVB1"
+_U8 = struct.Struct(">B")
+_U16 = struct.Struct(">H")
+_U32 = struct.Struct(">I")
+
+
+def encode_kv_block(leaves) -> bytes:
+    import numpy as np
+
+    parts = [KV_MAGIC, _U16.pack(len(leaves))]
+    for a in leaves:
+        a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode("ascii")
+        raw = a.tobytes()
+        parts.append(_U8.pack(len(dt)))
+        parts.append(dt)
+        parts.append(_U8.pack(a.ndim))
+        parts.append(struct.pack(f">{a.ndim}I", *a.shape))
+        parts.append(_U32.pack(len(raw)))
+        parts.append(raw)
+    body = b"".join(parts)
+    if len(body) > MAX_FRAME_BYTES:
+        raise FrameError(f"kv block of {len(body)} bytes exceeds max frame")
+    return body
+
+
+def decode_kv_block(buf: bytes):
+    """Inverse of ``encode_kv_block``. Raises ``FrameError`` on any
+    inconsistency (bad magic, torn header, length/shape mismatch,
+    trailing garbage) — the caller treats it exactly like a torn
+    transport frame: poison the connection, never the process."""
+    import numpy as np
+
+    view = memoryview(buf)
+    pos = 0
+
+    def take(n: int) -> memoryview:
+        nonlocal pos
+        if pos + n > len(view):
+            raise FrameError(
+                f"kv block truncated at byte {pos} (+{n}/{len(view)})")
+        out = view[pos:pos + n]
+        pos += n
+        return out
+
+    if bytes(take(len(KV_MAGIC))) != KV_MAGIC:
+        raise FrameError("kv block: bad magic")
+    (n_leaves,) = _U16.unpack(take(_U16.size))
+    leaves = []
+    for _ in range(n_leaves):
+        (dt_len,) = _U8.unpack(take(_U8.size))
+        try:
+            dtype = np.dtype(bytes(take(dt_len)).decode("ascii"))
+        except (UnicodeDecodeError, TypeError) as e:
+            raise FrameError(f"kv block: bad dtype: {e}") from e
+        (ndim,) = _U8.unpack(take(_U8.size))
+        shape = struct.unpack(f">{ndim}I", take(4 * ndim))
+        (raw_len,) = _U32.unpack(take(_U32.size))
+        want = int(dtype.itemsize) * int(np.prod(shape, dtype=np.int64))
+        if raw_len != want:
+            raise FrameError(
+                f"kv block: leaf {dtype}{shape} wants {want} bytes, "
+                f"frame carries {raw_len}")
+        leaves.append(
+            np.frombuffer(take(raw_len), dtype=dtype).reshape(shape).copy())
+    if pos != len(view):
+        raise FrameError(f"kv block: {len(view) - pos} trailing bytes")
+    return leaves
+
+
+def rpc(sock: socket.socket, req_id: int, method: str, params: dict,
+        frames=None):
     """One blocking request/response exchange. Raises ``ReplicaDied``
     when the peer is gone or the stream is poisoned, and re-raises
     worker-side ``ValueError`` as ``ValueError`` (so e.g. a
@@ -136,9 +251,17 @@ def rpc(sock: socket.socket, req_id: int, method: str, params: dict):
     msg = dict(params)
     msg["id"] = req_id
     msg["method"] = method
+    if frames:
+        msg["nframes"] = len(frames)
     try:
         send_frame(sock, msg)
+        for fr in frames or ():
+            send_binary_frame(sock, fr)
         resp = recv_frame(sock)
+        nresp = int(resp.get("nframes", 0)) if resp else 0
+        if nresp < 0 or nresp > MAX_ATTACHED_FRAMES:
+            raise FrameError(f"response announces {nresp} binary frames")
+        attached = [recv_binary_frame(sock) for _ in range(nresp)]
     except (OSError, FrameError) as e:
         raise ReplicaDied(f"rpc {method!r} failed: {e}") from e
     if resp is None:
@@ -151,7 +274,10 @@ def rpc(sock: socket.socket, req_id: int, method: str, params: dict):
         if err.get("type") == "ValueError":
             raise ValueError(err.get("msg", "worker ValueError"))
         raise ReplicaDied(f"rpc {method!r}: worker error {err}")
-    return resp.get("result") or {}
+    result = resp.get("result") or {}
+    if attached:
+        result["_frames"] = attached
+    return result
 
 
 # -- Request wire codec ----------------------------------------------------
@@ -184,6 +310,11 @@ def request_to_wire(req: Request) -> dict:
         "token_times": [float(t) for t in req.token_times],
         "blocks_registered": int(req._blocks_registered),
     }
+    if req._prompt_digests is not None:
+        # Hash-once, fleet-wide: the chained block digests computed at
+        # submit cross the wire so the worker's admission (and a later
+        # migration) never re-hashes the prompt.
+        d["prompt_digests"] = [dg.hex() for dg in req._prompt_digests]
     for f in _RUNTIME_FIELDS:
         d[f] = getattr(req, f)
     return d
@@ -211,6 +342,9 @@ def request_apply_wire(req: Request, d: dict) -> None:
     live worker exports: the worker's view is authoritative)."""
     req.generated = list(d.get("generated", req.generated))
     req.token_times = list(d.get("token_times", req.token_times))
+    if d.get("prompt_digests") is not None:
+        req._prompt_digests = [
+            bytes.fromhex(h) for h in d["prompt_digests"]]
     for f in _RUNTIME_FIELDS:
         if f in d:
             setattr(req, f, d[f])
@@ -350,7 +484,7 @@ class WorkerHandle:
     # One-shot armed transport fault (a net_* kind) for the next rpc().
     net_fault: Optional[str] = None
 
-    def rpc(self, method: str, params: Optional[dict] = None):
+    def rpc(self, method: str, params: Optional[dict] = None, frames=None):
         if self.sock is None:
             raise ReplicaDied(f"worker {self.worker_id}: no connection")
         self.next_id += 1
@@ -364,7 +498,8 @@ class WorkerHandle:
         fault, self.net_fault = self.net_fault, None
         if fault is not None:
             _inject_net_fault(fault, self.sock)
-        result = rpc(self.sock, self.next_id, method, params or {})
+        result = rpc(self.sock, self.next_id, method, params or {},
+                     frames=frames)
         if method == "step":
             self.first_step_done = True
         return result
@@ -409,6 +544,9 @@ class RemoteReplica:
             "oldest_arrival": None, "generated_tokens": 0,
             "prefix_hit_tokens": 0, "prompt_tokens": 0, "n_preemptions": 0,
         }
+        # Store digests the worker reported as newly put (piggybacked on
+        # load snapshots), buffered for the front-end's catalog drain.
+        self._kv_new: List[bytes] = []
 
     @property
     def worker_id(self) -> int:
@@ -418,12 +556,12 @@ class RemoteReplica:
     def worker_pid(self) -> int:
         return self._handle.pid
 
-    def _rpc(self, method: str, params: Optional[dict] = None):
+    def _rpc(self, method: str, params: Optional[dict] = None, frames=None):
         if self.dead:
             raise ReplicaDied(
                 f"worker {self._handle.worker_id} is already dead")
         try:
-            result = self._handle.rpc(method, params)
+            result = self._handle.rpc(method, params, frames=frames)
         except ReplicaDied:
             # The hung-RPC fence: a timed-out or poisoned exchange makes
             # this replica SUSPECT — maybe dead, maybe wedged, maybe
@@ -439,6 +577,8 @@ class RemoteReplica:
         load = result.get("load")
         if load is not None:
             self._load = load
+            for h in load.get("kv_new") or ():
+                self._kv_new.append(bytes.fromhex(h))
         # Every reply may piggyback the worker tracer's event delta —
         # one wire, no extra round-trips (worker.py drains per handler).
         trace = result.get("trace")
@@ -457,13 +597,22 @@ class RemoteReplica:
 
     # -- the replica surface the front-end consumes ------------------------
 
-    def submit(self, req: Request, trace: Optional[List[dict]] = None) -> None:
+    def submit(self, req: Request, trace: Optional[List[dict]] = None,
+               migration: Optional[dict] = None) -> None:
         params = {"req": request_to_wire(req), "now": self.clock()}
         if trace:
             # Front-door span context (submitted/routed) travels with the
             # request so the worker tracer holds the rid's full timeline.
             params["trace"] = trace
-        self._rpc("submit", params)
+        frames = None
+        if migration is not None:
+            # Migrated admission: the raw prompt tail (the last partial
+            # block, exact K/V bytes) rides a binary frame; full blocks
+            # travel separately as digest-addressed kv_put frames.
+            params["mig"] = {"tail_ntok": int(migration.get("tail_ntok", 0))}
+            if migration.get("leaves") is not None:
+                frames = [encode_kv_block(migration["leaves"])]
+        self._rpc("submit", params, frames=frames)
         self._reqs[req.rid] = req
 
     def step(self) -> List[Request]:
@@ -516,6 +665,61 @@ class RemoteReplica:
         """Arm a one-shot transport fault (a ``net_*`` chaos kind) on
         this replica's next RPC."""
         self._handle.net_fault = kind
+
+    # -- KV store / disaggregation verbs -----------------------------------
+
+    def kv_put(self, digest: bytes, leaves) -> bool:
+        """Push one block entry into the worker's local store (binary
+        frame attached to the JSON verb). Idempotent like the store."""
+        result = self._rpc("kv_put", {"digest": digest.hex()},
+                           frames=[encode_kv_block(leaves)])
+        return bool(result.get("stored"))
+
+    def kv_get(self, digest: bytes):
+        """``(tier, leaves)`` from the worker's store, or None."""
+        result = self._rpc("kv_get", {"digest": digest.hex()})
+        if not result.get("found"):
+            return None
+        return result["tier"], decode_kv_block(result["_frames"][0])
+
+    def kv_has(self, digests) -> List[bool]:
+        result = self._rpc("kv_has",
+                           {"digests": [d.hex() for d in digests]})
+        return [bool(b) for b in result.get("has", ())]
+
+    def set_role(self, role: Optional[str]) -> None:
+        self._rpc("set_role", {"role": role})
+
+    def migratable_rids(self) -> List[int]:
+        """Prefill-complete rids from the worker's last load snapshot —
+        exact between our own RPCs, like every other load field."""
+        return [int(r) for r in self._load.get("migratable") or ()]
+
+    def drain_new_digests(self) -> List[bytes]:
+        out, self._kv_new = self._kv_new, []
+        return out
+
+    def extract(self, rid: int):
+        """Pull one prefill-complete request off the worker for
+        migration: the worker vacates it (slot + blocks freed, full
+        blocks already in its store via write-through) and ships the
+        authoritative request state plus the raw prompt-tail block.
+        Returns ``(req, payload)`` or None; the mirror is popped — the
+        request now belongs to whichever replica it is resubmitted to."""
+        result = self._rpc("extract", {"rid": rid, "now": self.clock()})
+        if not result.get("found"):
+            return None
+        d = result["req"]
+        req = self._reqs.pop(rid, None)
+        if req is None:
+            req = request_from_wire(d)
+        else:
+            request_apply_wire(req, d)
+        payload = {"tail_ntok": int(result.get("tail_ntok", 0)),
+                   "leaves": None}
+        if payload["tail_ntok"] and result.get("_frames"):
+            payload["leaves"] = decode_kv_block(result["_frames"][0])
+        return req, payload
 
     def has_work(self) -> bool:
         return bool(self._load["has_work"])
@@ -604,6 +808,14 @@ class RemoteReplica:
     @property
     def n_preemptions(self) -> int:
         return int(self._load["n_preemptions"])
+
+    @property
+    def store_hit_tokens_host(self) -> int:
+        return int(self._load.get("store_hit_tokens_host", 0))
+
+    @property
+    def store_hit_tokens_disk(self) -> int:
+        return int(self._load.get("store_hit_tokens_disk", 0))
 
 
 # -- supervision -----------------------------------------------------------

@@ -129,7 +129,14 @@ class Request:
     spec_accepted: int = 0
     spec_steps: int = 0
     _blocks_registered: int = 0        # prompt blocks published to the index
-    _prompt_digests = None             # lazily built chained block digests
+    # Chained block digests of the prompt, hashed ONCE per request — the
+    # front-end caches them at submit; the router's affinity key, admission
+    # prefix_lookup and the prefix-index registration all read this list.
+    _prompt_digests = None
+    # Migrated raw-tail KV payload ({"tail_ntok", "leaves"}) attached by the
+    # front-end between extract on a prefill replica and admission on the
+    # decode replica; consumed (and cleared) by the first admission.
+    _kv_migration = None
     _key = None                        # lazily built [2] uint32 PRNG key
 
     def context_len(self) -> int:
@@ -175,10 +182,16 @@ class Scheduler:
         self.running: List[Request] = []   # admission order
         self._free_slots = list(range(cache.slots))
         self._last_was_prefill = False
+        # False on prefill-role engines (disaggregated serving): requests
+        # finish prefill + their first sampled token, then idle until the
+        # front-end extracts them for migration to a decode replica.
+        self.decode_enabled = True
         self.n_preemptions = 0
         self.n_admissions = 0          # admission events (re-admits count)
         self.prefix_hit_tokens = 0
         self.prompt_tokens = 0         # prompt tokens over all admissions
+        self.n_migrated_tail_fills = 0  # migrated raw tails admitted
+        self.n_migration_declined = 0   # tails priced out (recompute won)
         # Terminal transitions by state, counted at the single funnel
         # (retire + the waiting-queue branches of cancel/expire that
         # bypass it). The metrics plane mirrors these monotone counts.
@@ -285,6 +298,22 @@ class Scheduler:
             self._emit(req, "exported", generated=len(req.generated))
         return out
 
+    def extract(self, req: Request) -> None:
+        """Migration handoff: strip ONE running request out of this
+        scheduler (blocks released, cursors reset, status waiting) for
+        resubmission on another replica. ``export_requests``' contract
+        without the preemption accounting: the K/V it computed survives
+        in the prefix index / fleet store (the caller harvests BEFORE
+        calling), and the (seed, token_index) sampling contract keeps
+        the resumed stream token-identical wherever it lands."""
+        self._vacate(req)
+        req.status = "waiting"
+        req.prefill_cursor = 0
+        req.prefill_target = 0
+        req.prefill_chunk = 0
+        self._emit(req, "exported", generated=len(req.generated),
+                   migrated=True)
+
     # -- the per-iteration decision ---------------------------------------
 
     def _admit(self) -> List[Request]:
@@ -297,7 +326,15 @@ class Scheduler:
                and len(admitted) < self.max_prefill_rows):
             req = self.waiting[0]
             ctx = req.context_len()
-            shared, matched = self.cache.prefix_lookup(req.prompt)
+            if req._prompt_digests is None and self.cache.prefix_cache:
+                req._prompt_digests = self.cache.block_digests(req.prompt)
+            mig = req._kv_migration
+            # A migrating request arrives with generated tokens, so the
+            # copy-on-write cap widens to every full prompt block — the
+            # token it feeds next is a generated one.
+            shared, matched = self.cache.prefix_lookup(
+                req.prompt, digests=req._prompt_digests,
+                context_len=ctx if mig is not None else None)
             budget_blocks = min(
                 self.cache.blocks_for(ctx + self.spec_reserve_tokens),
                 self.cache.max_blocks)
@@ -314,6 +351,10 @@ class Scheduler:
                 self.cache.pool.retain(shared)
             slot = self._free_slots.pop(0)
             self.cache.assign(slot, shared + fresh)
+            if mig is not None:
+                matched = self._ingest_migrated_tail(
+                    req, mig, matched, fresh)
+                req._kv_migration = None
             self.cache.lengths[slot] = matched
             req.slot = slot
             req.status = "running"
@@ -338,6 +379,34 @@ class Scheduler:
                            resumed=True)
         return admitted
 
+    def _ingest_migrated_tail(self, req: Request, mig: dict,
+                              matched: int, fresh: List[int]) -> int:
+        """Admission half of KV migration: the sender's full prompt
+        blocks arrived digest-addressed through the store/prefix index
+        (``matched`` covers them), and the sub-block tail rides raw in
+        ``mig``. When every full block matched, the tail's leaves are
+        written into the request's FIRST private block — exactly where
+        prefill would have put them — and the cursor starts past them.
+        Any shortfall (partial match, dry hook, pricer preferring
+        recompute) falls back to plain prefill of the remainder, which
+        is always correct."""
+        ntok = int(mig.get("tail_ntok") or 0)
+        leaves = mig.get("leaves")
+        full = (len(req.prompt) // self.cache.block_size) * self.cache.block_size
+        if ntok <= 0 or leaves is None or matched != full or not fresh:
+            return matched
+        pricer = self.cache.pricer
+        if pricer is not None:
+            from tpu_trainer.serving.kv_store import leaves_nbytes
+
+            if not pricer.prefers_transfer(ntok, leaves_nbytes(leaves)):
+                self.n_migration_declined += 1
+                return matched
+        if not self.cache.fill_raw(fresh[0], leaves):
+            return matched
+        self.n_migrated_tail_fills += 1
+        return matched + ntok
+
     def schedule(self) -> Tuple[str, List[Request]]:
         """Decide this iteration. Unchunked: ``("prefill", admitted)``
         when the queue head fits the budget (prefill has priority — it
@@ -348,7 +417,8 @@ class Scheduler:
         has ``prefill_chunk`` set to the tokens to feed now."""
         self._admit()
         prefilling = [r for r in self.running if r.prefilling()]
-        decodable = [r for r in self.running if not r.prefilling()]
+        decodable = ([r for r in self.running if not r.prefilling()]
+                     if self.decode_enabled else [])
         if prefilling and decodable and self.prefill_chunk_tokens:
             do_prefill = not self._last_was_prefill
         else:

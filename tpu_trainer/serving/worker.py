@@ -42,11 +42,16 @@ import sys
 from typing import Dict, List, Optional
 
 from tpu_trainer.serving.remote import (
+    MAX_ATTACHED_FRAMES,
     FrameError,
+    decode_kv_block,
+    encode_kv_block,
     load_params_npz,
+    recv_binary_frame,
     recv_frame,
     request_from_wire,
     request_to_wire,
+    send_binary_frame,
     send_frame,
 )
 from tpu_trainer.serving.scheduler import Request, TERMINAL_STATES
@@ -138,7 +143,7 @@ class WorkerServer:
     def _load(self) -> dict:
         eng = self.engine
         arr = eng.scheduler.oldest_waiting_arrival
-        return {
+        d = {
             "queue_depth": int(eng.queue_depth),
             "outstanding_tokens": int(eng.outstanding_tokens),
             "has_work": bool(eng.scheduler.has_work()),
@@ -147,7 +152,20 @@ class WorkerServer:
             "prefix_hit_tokens": int(eng.scheduler.prefix_hit_tokens),
             "prompt_tokens": int(eng.scheduler.prompt_tokens),
             "n_preemptions": int(eng.scheduler.n_preemptions),
+            "store_hit_tokens_host": int(
+                eng.cache_state.store_hit_tokens_host),
+            "store_hit_tokens_disk": int(
+                eng.cache_state.store_hit_tokens_disk),
         }
+        if eng.kv_store is not None:
+            # Newly stored digests since the last reply — the front-end
+            # catalogs them (digest -> holder) with zero extra RPCs.
+            new = eng.kv_store.drain_new_digests()
+            if new:
+                d["kv_new"] = [dg.hex() for dg in new]
+        if eng.role == "prefill":
+            d["migratable"] = eng.migratable_rids()
+        return d
 
     # -- handlers ----------------------------------------------------------
 
@@ -183,6 +201,19 @@ class WorkerServer:
             ctx = msg.get("trace")
             if ctx:
                 self.engine.tracer.ingest(ctx)
+            mig = msg.get("mig")
+            if mig is not None:
+                # Migrated admission: full blocks are already in our
+                # store (kv_put'd by the front-end); the raw tail rides
+                # the attached binary frame. Admission prices the tail
+                # and every store fill against recompute per block.
+                leaves = None
+                frames = msg.get("_frames") or ()
+                if frames:
+                    leaves = decode_kv_block(frames[0])
+                req._kv_migration = {
+                    "tail_ntok": int(mig.get("tail_ntok", 0)),
+                    "leaves": leaves}
             self.engine.scheduler.add(req)
             self._reqs[req.rid] = req
             self._sent[req.rid] = len(req.generated)
@@ -224,6 +255,55 @@ class WorkerServer:
                 self._sent.pop(r.rid, None)
             return {"requests": [request_to_wire(r) for r in reqs],
                     "load": self._load()}
+        if method == "kv_put":
+            store = self.engine.kv_store
+            if store is None:
+                raise ValueError("kv_put: this worker has no kv store")
+            frames = msg.get("_frames") or ()
+            if not frames:
+                raise ValueError("kv_put without a payload frame")
+            stored = store.put(bytes.fromhex(msg["digest"]),
+                               decode_kv_block(frames[0]))
+            # A pushed block is not "new" to the fleet — the front-end
+            # already knows it; don't echo it back through the catalog.
+            store.drain_new_digests()
+            return {"stored": bool(stored), "load": self._load()}
+        if method == "kv_get":
+            store = self.engine.kv_store
+            hit = (None if store is None
+                   else store.get(bytes.fromhex(msg["digest"])))
+            if hit is None:
+                return {"found": False, "load": self._load()}
+            tier, leaves = hit
+            return {"found": True, "tier": tier,
+                    "_frames": [encode_kv_block(leaves)],
+                    "load": self._load()}
+        if method == "kv_has":
+            store = self.engine.kv_store
+            digs = [bytes.fromhex(h) for h in msg.get("digests", ())]
+            return {"has": [bool(store is not None and store.has(d))
+                            for d in digs],
+                    "load": self._load()}
+        if method == "set_role":
+            self.engine.set_role(msg.get("role"))
+            return {"load": self._load()}
+        if method == "extract":
+            self._now_value = float(msg.get("now", self._now_value))
+            rid = int(msg["rid"])
+            out = self.engine.extract_request(rid)
+            if out is None:
+                return {"found": False, "load": self._load()}
+            req, payload = out
+            self._reqs.pop(rid, None)
+            self._sent.pop(rid, None)
+            result = {"found": True, "req": request_to_wire(req),
+                      "tail_ntok": 0, "load": self._load()}
+            if payload is not None:
+                result["tail_ntok"] = int(payload["tail_ntok"])
+                # Block-aligned contexts have no raw tail to ship.
+                if payload.get("leaves") is not None:
+                    result["_frames"] = [encode_kv_block(payload["leaves"])]
+            return result
         if method == "summary":
             return {"summary": _jsonable(self.engine.summary()),
                     "load": self._load()}
@@ -278,8 +358,25 @@ class WorkerServer:
                     return              # poisoned stream: drop this client
                 if msg is None:
                     return              # clean disconnect
+                nf = int(msg.get("nframes", 0) or 0)
+                if nf:
+                    # Attached binary frames (kv_put payloads, migration
+                    # tails) follow the JSON frame immediately. A torn
+                    # or over-announced batch poisons this connection
+                    # only, exactly like a torn JSON frame.
+                    if nf < 0 or nf > MAX_ATTACHED_FRAMES:
+                        return
+                    try:
+                        msg["_frames"] = [
+                            recv_binary_frame(conn) for _ in range(nf)]
+                    except FrameError:
+                        return
+                out_frames: List[bytes] = []
                 try:
                     result = self.handle(msg)
+                    # Binary payloads leave the JSON result and trail the
+                    # response as announced attached frames.
+                    out_frames = result.pop("_frames", None) or []
                     # Piggyback the engine tracer's span-event delta on
                     # every reply: worker-side events (admitted, prefill
                     # chunks, first_token, spec windows, terminals)
@@ -296,9 +393,13 @@ class WorkerServer:
                     resp = {"id": msg.get("id"), "ok": False,
                             "error": {"type": type(e).__name__,
                                       "msg": str(e)}}
+                if out_frames:
+                    resp["nframes"] = len(out_frames)
                 try:
                     send_frame(conn, _jsonable(resp))
-                except OSError:
+                    for fr in out_frames:
+                        send_binary_frame(conn, fr)
+                except (OSError, FrameError):
                     return
                 self._beat()
         finally:

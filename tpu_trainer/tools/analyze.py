@@ -296,6 +296,10 @@ def summarize(records: List[dict]) -> dict:
             "finished", "cancelled", "deadline_exceeded",
             "tp", "device_pool_blocks", "total_pool_blocks",
             "wire_bytes_per_worker", "wire_ratio", "tp_token_match",
+            "fleet_prefix_hit_rate", "store_hit_tokens",
+            "store_hit_tokens_host", "store_hit_tokens_disk",
+            "migrations", "migrated_bytes",
+            "baseline_prefix_hit_rate", "disagg_token_match",
             ) if f.get(k) is not None}
 
     # Sharded-decode (tensor-parallel) parity: EVERY record that carries
@@ -359,6 +363,36 @@ def summarize(records: List[dict]) -> dict:
                 "random_prefix_hit_rate": ab.get("random_prefix_hit_rate"),
                 "tok_s_vs_random": ab.get("tok_s_vs_random"),
             }
+
+    # Disaggregated-serving / fleet-KV-store metrics live on whichever
+    # lane ran with the store (serve_bench --disagg stamps the disagg
+    # lane; a plain kv_store lane carries store counters too) — the
+    # newest store-bearing record wins the summary so a later storeless
+    # lane can't shadow it. Like tp parity, the migrated-stream verdict
+    # counts EVERY record carrying one: a single migrated stream that
+    # diverged from the single-engine pin is a real divergence, not
+    # noise the newest record should hide.
+    dis_recs = [r for r in fronts
+                if r.get("disagg_token_match") is not None
+                or r.get("migrations") or r.get("store_hit_tokens")]
+    if dis_recs:
+        d = dis_recs[-1]
+        pinned = [r for r in fronts
+                  if r.get("disagg_token_match") is not None]
+        bad = [r.get("lane") for r in pinned
+               if not r["disagg_token_match"]]
+        report["disagg"] = {k: d.get(k) for k in (
+            "lane", "workload", "routing",
+            "fleet_prefix_hit_rate", "baseline_prefix_hit_rate",
+            "store_hit_tokens", "store_hit_tokens_host",
+            "store_hit_tokens_disk", "migrations", "migrated_bytes",
+            ) if d.get(k) is not None}
+        report["disagg"]["records"] = len(pinned)
+        report["disagg"]["mismatched"] = len(bad)
+        report["disagg"]["mismatched_lanes"] = bad
+        roles = [p.get("role") for p in (d.get("per_replica") or ())]
+        if any(roles):
+            report["disagg"]["roles"] = roles
 
     # Serve-timeline section: kind:"span" records (serving/tracing.py,
     # emitted by serve_bench per finished rid). Phase percentiles and the
@@ -795,6 +829,29 @@ def render(report: dict) -> List[str]:
                 f" {_fmt(ab.get('random_prefix_hit_rate'))}"
                 + (f" | tok/s x{_fmt(ab.get('tok_s_vs_random'))}"
                    if ab.get("tok_s_vs_random") is not None else ""))
+    dis = report.get("disagg")
+    if dis:
+        line = (f"disagg  lane {dis.get('lane')}: fleet prefix hit"
+                f" {_fmt(dis.get('fleet_prefix_hit_rate'))}")
+        if dis.get("baseline_prefix_hit_rate") is not None:
+            line += (f" vs per-replica baseline"
+                     f" {_fmt(dis.get('baseline_prefix_hit_rate'))}")
+        if dis.get("roles"):
+            line += f" | roles {'/'.join(str(r) for r in dis['roles'])}"
+        lines.append(line)
+        lines.append(
+            f"disagg  store-hit tokens {dis.get('store_hit_tokens') or 0}"
+            f" (host {dis.get('store_hit_tokens_host') or 0} / disk"
+            f" {dis.get('store_hit_tokens_disk') or 0})"
+            f" | migrations {dis.get('migrations') or 0}"
+            f" ({dis.get('migrated_bytes') or 0} B)")
+        if dis.get("records"):
+            lines.append(
+                f"disagg  parity: {dis['records']} store lanes vs"
+                f" single-engine pin, {dis['mismatched']} diverged"
+                + (f" ({', '.join(str(x) for x in dis['mismatched_lanes'])})"
+                   f"  ** MIGRATED STREAMS DIVERGED **"
+                   if dis["mismatched"] else " (all bit-exact)"))
     sp = report.get("spans")
     if sp:
         flag = "" if sp.get("conservation_ok") else (
@@ -906,7 +963,8 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             deadline_miss_tol: float = 0.05,
             stall_recovery_tol: float = 30.0,
             queue_wait_tol: float = 1.0,
-            tp_parity_tol: float = 0.0) -> List[dict]:
+            tp_parity_tol: float = 0.0,
+            fleet_hit_tol: float = 0.05) -> List[dict]:
     """PASS/FAIL/SKIP verdicts for ``new`` against baseline ``base``.
 
     Relative regressions at or beyond the tolerance FAIL (so exactly-10%
@@ -1004,6 +1062,21 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
       mid-call) must stay under ``stall_recovery_tol`` seconds — the
       per-call timeout exists precisely to bound this. SKIP when the
       run had no such stall.
+    - ``frontend_fleet_hit`` is ABSOLUTE in fraction points against the
+      baseline run: the fleet-wide token-weighted prefix hit rate (device
+      hits plus store-fill hits, serve_bench ``--disagg`` /
+      ``--kv-store-mb``) dropping by >= ``fleet_hit_tol`` points means
+      the digest-addressed store stopped rescuing cross-replica misses —
+      a store that hid 60% of prefill yesterday and 40% today is a real
+      capacity loss even if both clear some relative bar. Relative would
+      mis-scale exactly like ``non_pad_frac``. SKIP when either run has
+      no fleet hit rate.
+    - ``frontend_disagg_parity`` is categorical, like tp parity: every
+      lane that serve_bench pinned against a single undisturbed engine
+      (``disagg_token_match``) must match bit-exactly — migration moves
+      K/V blocks, never token distributions, so ANY diverged migrated
+      stream is a codec/fill/ordering bug, not a regression to tolerate.
+      SKIP when the new run pinned nothing.
     """
     def get(report, *keys):
         cur = report
@@ -1342,6 +1415,50 @@ def compare(base: dict, new: dict, *, tok_tol: float = 0.10,
             "absolute": True,
         })
 
+    # Fleet-wide prefix hit rate is ABSOLUTE in fraction points against
+    # the baseline run (the disagg summary's store-bearing lane wins,
+    # falling back to the newest frontend record): the whole point of
+    # the fleet store is that rate, so it regresses in points, not
+    # percent-of-itself.
+    def fleet_hit(report):
+        v = get(report, "disagg", "fleet_prefix_hit_rate")
+        return v if v is not None else get(
+            report, "frontend", "fleet_prefix_hit_rate")
+
+    b_fleet, n_fleet = fleet_hit(base), fleet_hit(new)
+    if b_fleet is None or n_fleet is None:
+        verdicts.append({"metric": "frontend_fleet_hit", "verdict": "SKIP",
+                         "base": b_fleet, "new": n_fleet})
+    else:
+        delta = b_fleet - n_fleet  # absolute, in fraction points
+        verdicts.append({
+            "metric": "frontend_fleet_hit",
+            "verdict": "FAIL" if delta >= fleet_hit_tol - eps else "PASS",
+            "base": round(b_fleet, 4),
+            "new": round(n_fleet, 4),
+            "tolerance_frac": fleet_hit_tol,
+            "absolute": True,
+        })
+
+    # Migrated-stream parity is categorical, like tp parity and span
+    # conservation: any lane whose streams diverged from the
+    # single-engine pin FAILs, whatever the baseline did.
+    n_dis = get(new, "disagg") or {}
+    if not n_dis.get("records"):
+        verdicts.append({"metric": "frontend_disagg_parity",
+                         "verdict": "SKIP",
+                         "base": (get(base, "disagg") or {}).get(
+                             "mismatched"),
+                         "new": None})
+    else:
+        verdicts.append({
+            "metric": "frontend_disagg_parity",
+            "verdict": "FAIL" if n_dis["mismatched"] else "PASS",
+            "base": (get(base, "disagg") or {}).get("mismatched"),
+            "new": n_dis["mismatched"],
+            "absolute": True,
+        })
+
     # Queue-wait p99 is ABSOLUTE against a fixed budget: admission-to-
     # arrival latency is an SLO input, not a baseline-relative number —
     # a queue that was already slow must not grandfather itself in.
@@ -1518,6 +1635,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "(default 0.0 — sharded decode is exact by "
                              "construction, one diverged lane fails); "
                              "SKIP when the run served nothing sharded")
+    parser.add_argument("--fleet-hit-tol", type=float, default=0.05,
+                        help="ABSOLUTE gate on the fleet-wide token-"
+                             "weighted prefix hit rate (device + KV-store "
+                             "fills, serve_bench --disagg / --kv-store-mb): "
+                             "FAIL if the new run's rate drops by >= this "
+                             "many fraction points vs the baseline "
+                             "(default 0.05); SKIP when either run has no "
+                             "fleet hit rate. Migrated-stream parity vs "
+                             "the single-engine pin needs no tolerance: "
+                             "any diverged stream is a categorical FAIL")
     parser.add_argument("--json", action="store_true",
                         help="print the report (and verdicts) as JSON")
     args = parser.parse_args(argv)
@@ -1549,7 +1676,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             deadline_miss_tol=args.deadline_miss_tol,
             stall_recovery_tol=args.stall_recovery_tol,
             queue_wait_tol=args.queue_wait_tol,
-            tp_parity_tol=args.tp_parity_tol)
+            tp_parity_tol=args.tp_parity_tol,
+            fleet_hit_tol=args.fleet_hit_tol)
 
     exit_code = (1 if verdicts is not None
                  and any(v["verdict"] == "FAIL" for v in verdicts) else 0)
