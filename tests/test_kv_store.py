@@ -238,12 +238,41 @@ class TestKVBlockStore:
     def test_oversized_entry_skips_host_tier(self, tmp_path):
         big = [np.zeros(1024, np.float32)]             # 4 KiB > 1 KiB budget
         store = KVBlockStore(host_bytes=1024)
-        store.put(b"big!" * 4, big)
-        assert not store.has(b"big!" * 4)              # no disk: dropped
+        # Dropped entirely (no disk tier): not stored, not counted, not
+        # announced — the catalog must never advertise a digest the
+        # store doesn't hold.
+        assert not store.put(b"big!" * 4, big)
+        assert not store.has(b"big!" * 4)
+        assert store.counters["puts"] == 0
+        assert store.counters["put_bytes"] == 0
+        assert store.drain_new_digests() == []
         store = KVBlockStore(host_bytes=1024, disk_dir=str(tmp_path))
-        store.put(b"big!" * 4, big)
+        assert store.put(b"big!" * 4, big)
         assert store.get(b"big!" * 4) is not None
         assert store.host_bytes_used <= 1024
+
+    def test_oversized_disk_entry_does_not_flush_tier(self, tmp_path):
+        store = KVBlockStore(host_bytes=1024, disk_dir=str(tmp_path),
+                             disk_bytes=2048)
+        for i in range(6):                             # spills two to disk
+            store.put(bytes([i]) * 16, self._entry(i))
+        assert store.disk_bytes_used > 0
+        before = dict(store._disk)
+        huge = [np.zeros(4096, np.float32)]            # 16 KiB > both tiers
+        # An entry that could never fit must be rejected BEFORE the disk
+        # eviction loop — not flush the whole tier and then store nothing.
+        assert not store.put(b"huge" * 4, huge)
+        assert dict(store._disk) == before
+        assert store.counters["evictions_disk"] == 0
+
+    def test_unannounced_put_stays_out_of_catalog_feed(self):
+        store = KVBlockStore(host_bytes=1 << 20)
+        # announce=False is the pushed-block path: stored and counted,
+        # but never echoed back through the new-digest feed.
+        assert store.put(b"p" * 16, self._entry(1), announce=False)
+        assert store.put(b"q" * 16, self._entry(2))
+        assert store.counters["puts"] == 2
+        assert store.drain_new_digests() == [b"q" * 16]
 
     def test_entry_nbytes_and_new_digest_feed(self):
         store = KVBlockStore(host_bytes=1 << 20)

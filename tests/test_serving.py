@@ -535,13 +535,46 @@ class TestPrefixCOW:
         cache.pool.free(blocks)          # engine released; index holds on
         assert cache.evictable_blocks == 3
         assert cache.available_blocks == 4
-        shared, _ = cache.prefix_lookup(toks)   # LRU-touches blocks[:2]
-        cache.pool.retain(shared)        # ...and a request now shares them
+        # prefix_lookup LRU-touches blocks[:2] AND pins them with a
+        # caller-owned reference — the in-flight request shares them
+        # from the walk itself.
+        shared, _ = cache.prefix_lookup(toks)
+        assert all(cache.pool.refcount(b) == 2 for b in shared)
         assert cache.evictable_blocks == 1
         got = cache.alloc_blocks(2)      # 1 free + evict the cold block
         assert got is not None and blocks[2] in got
         assert cache.n_prefix_evictions == 1
         assert cache.alloc_blocks(1) is None   # shared blocks untouchable
+
+    def test_store_fill_never_evicts_in_flight_matches(self):
+        """Saturated pool, and the lookup's own matches are the only
+        refcount-1 index entries: the store fall-through for a LATER
+        digest allocates a fill block, and its eviction backstop must
+        not reclaim a block the walk already returned — the freed id
+        could come back as the fill target, silently aliasing two
+        digests. The pin taken inside the walk makes the fill fail
+        (dry pool) and the match survive intact."""
+        from tpu_trainer.serving.kv_store import KVBlockStore
+
+        cache = self._cache(num_blocks=3)      # 2 usable blocks
+        store = KVBlockStore(host_bytes=1 << 20)
+        cache.store = store
+        cache.fill_fn = lambda dig, bid: "host"
+        toks = list(range(1, 25))              # 3 full blocks
+        digs = cache.block_digests(toks)
+        # Digest 0 on device (index-only, refcount 1); digest 1 only in
+        # the fleet store; the second usable block pinned by a live
+        # request, so the fill allocation can only evict.
+        (b0,) = cache.alloc_blocks(1)
+        cache.prefix_register(digs[0], b0)
+        cache.pool.free([b0])
+        store.put(digs[1], [np.zeros((8, 2, 4), np.float32)])
+        cache.alloc_blocks(1)                  # live request's block
+        shared, matched = cache.prefix_lookup(toks)
+        assert shared == [b0] and matched == 8
+        assert cache._prefix.get(digs[0]) == b0    # match not evicted
+        assert digs[1] not in cache._prefix        # fill correctly dry
+        assert cache.pool.refcount(b0) == 2        # index + caller pin
 
     def test_prefix_hit_skips_exactly_cached_blocks(self, params):
         plen = 20                        # 2 full blocks + a 4-token tail

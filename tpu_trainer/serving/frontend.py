@@ -880,13 +880,14 @@ class ServingFrontend:
                 if holder is None:
                     continue
                 hit = holder.engine.kv_get(dig)
-                if hit is not None:
-                    target.engine.kv_put(dig, hit[1])
+                if hit is not None and target.engine.kv_put(dig, hit[1]):
                     self._kv_catalog[dig] = target.rid
                     self.stats["store_synced_blocks"] += 1
-        except ReplicaDied:
-            # The dead side is settled by the next step/poll cycle; the
-            # request itself is unaffected (recompute is always correct).
+        except (ReplicaDied, ValueError):
+            # A dead side is settled by the next step/poll cycle; a
+            # ValueError means the target can't take the push (torn
+            # frame, mixed fleet). Either way the request is unaffected
+            # (recompute is always correct).
             pass
 
     # -- cancellation ------------------------------------------------------
@@ -1185,7 +1186,15 @@ class ServingFrontend:
             pass
         nbytes = 0
         for dig, leaves in pulled:
-            dst.engine.kv_put(dig, leaves)
+            try:
+                stored = dst.engine.kv_put(dig, leaves)
+            except ValueError:
+                # The target can't take pushes (no local store, torn
+                # frame): it recomputes instead — pushes are never
+                # load-bearing. Only ReplicaDied may escape this loop.
+                break
+            if not stored:
+                continue
             self._kv_catalog[dig] = dst.rid
             nbytes += leaves_nbytes(leaves)
             self.stats["migration_pushed_blocks"] += 1

@@ -86,7 +86,12 @@ class KVBlockStore:
         return os.path.join(self.disk_dir, digest.hex() + ".npz")
 
     def _disk_put(self, digest: bytes, leaves: List[np.ndarray],
-                  nbytes: int) -> None:
+                  nbytes: int) -> bool:
+        """True when the entry landed on disk. An entry too big for the
+        whole tier is rejected BEFORE the eviction loop — it could never
+        fit, so evicting for it would just flush the tier for nothing."""
+        if nbytes > self.disk_budget:
+            return False
         while self._disk and self.disk_bytes_used + nbytes > self.disk_budget:
             old, old_n = self._disk.popitem(last=False)
             self.disk_bytes_used -= old_n
@@ -95,8 +100,6 @@ class KVBlockStore:
                 os.remove(self._disk_path(old))
             except OSError:
                 pass
-        if nbytes > self.disk_budget:
-            return
         # Atomic publish: a torn write must never surface as a partial npz.
         fd, tmp = tempfile.mkstemp(dir=self.disk_dir, suffix=".tmp")
         try:
@@ -108,9 +111,10 @@ class KVBlockStore:
                 os.remove(tmp)
             except OSError:
                 pass
-            return
+            return False
         self._disk[digest] = nbytes
         self.disk_bytes_used += nbytes
+        return True
 
     def _disk_get(self, digest: bytes) -> Optional[List[np.ndarray]]:
         if digest not in self._disk:
@@ -133,30 +137,38 @@ class KVBlockStore:
                 pass
 
     def _host_insert(self, digest: bytes, leaves: List[np.ndarray],
-                     nbytes: int) -> None:
+                     nbytes: int) -> bool:
+        """True when the entry is actually held by SOME tier afterwards
+        — the caller only counts/announces a put that stuck."""
         if nbytes > self.host_budget:
             # Oversized for the host tier entirely: disk or drop.
-            if self.disk_dir:
-                self._disk_put(digest, leaves, nbytes)
-            return
+            return bool(self.disk_dir) and self._disk_put(
+                digest, leaves, nbytes)
         while self._host and self.host_bytes_used + nbytes > self.host_budget:
             old, old_leaves = self._host.popitem(last=False)
             old_n = self._host_nbytes.pop(old)
             self.host_bytes_used -= old_n
             self.counters["evictions_host"] += 1
             if self.disk_dir and old not in self._disk:
-                self._disk_put(old, old_leaves, old_n)
-                self.counters["spills_to_disk"] += 1
+                if self._disk_put(old, old_leaves, old_n):
+                    self.counters["spills_to_disk"] += 1
         self._host[digest] = leaves
         self._host_nbytes[digest] = nbytes
         self.host_bytes_used += nbytes
+        return True
 
     # -- public surface ----------------------------------------------------
 
-    def put(self, digest: bytes, leaves: List[np.ndarray]) -> bool:
-        """Insert one block entry. Returns False (and touches LRU) when
-        the digest is already stored — content addressing makes the
-        duplicate bytes identical by construction."""
+    def put(self, digest: bytes, leaves: List[np.ndarray], *,
+            announce: bool = True) -> bool:
+        """Insert one block entry. Returns True only when the entry was
+        actually stored in some tier: False (with an LRU touch) for a
+        duplicate digest — content addressing makes the duplicate bytes
+        identical by construction — and False for an entry no tier
+        could hold, which is neither counted nor announced (the catalog
+        must never advertise a digest the store doesn't have).
+        ``announce=False`` skips the new-digest catalog feed — for
+        blocks PUSHED by the front-end, which knows them already."""
         if digest in self._host:
             self._host.move_to_end(digest)
             self.counters["dup_puts"] += 1
@@ -166,14 +178,17 @@ class KVBlockStore:
             return False
         leaves = [np.ascontiguousarray(a) for a in leaves]
         nbytes = leaves_nbytes(leaves)
-        self._host_insert(digest, leaves, nbytes)
+        if not self._host_insert(digest, leaves, nbytes):
+            return False
         self.counters["puts"] += 1
         self.counters["put_bytes"] += nbytes
-        self._new.append(digest)
-        # A standalone engine never drains the catalog feed; keep only
-        # the newest announcements rather than growing without bound.
-        if len(self._new) > 4096:
-            del self._new[:-4096]
+        if announce:
+            self._new.append(digest)
+            # A standalone engine never drains the catalog feed; keep
+            # only the newest announcements rather than growing without
+            # bound.
+            if len(self._new) > 4096:
+                del self._new[:-4096]
         return True
 
     def get(self, digest: bytes) -> Optional[Tuple[str, List[np.ndarray]]]:

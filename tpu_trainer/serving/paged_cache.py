@@ -239,6 +239,15 @@ class PagedKVCache:
         starts at a block boundary in a private block. Hits touch the
         LRU order. Returns ``([], 0)`` when the index is off.
 
+        The returned blocks carry ONE caller-owned reference each (on
+        top of the index's): the caller either installs them in a slot
+        table — ``release``/``shrink`` drop the reference later — or
+        must ``pool.free`` them when admission is abandoned. Retaining
+        eagerly, inside the walk, is what keeps the match safe: the
+        store fall-through allocates a device block per missed digest,
+        and that allocation's eviction backstop may only reclaim
+        refcount-1 index entries — which an un-retained match still is.
+
         ``digests`` skips re-hashing when the caller already computed
         the prompt's chained digests (cached on the ``Request`` at
         submit). ``context_len`` widens the cap for requests resuming
@@ -265,6 +274,12 @@ class PagedKVCache:
                 bid = self._store_fill(dig)
             if bid is None:
                 break
+            # Pin the match NOW: a later digest's store fall-through
+            # allocates a fill block, and at refcount 1 this match would
+            # be fair game for that allocation's eviction backstop — the
+            # freed id could even come back as the fill target, leaving
+            # ``shared`` pointing at a different digest's K/V.
+            self.pool.retain([bid])
             self._prefix.move_to_end(dig)
             shared.append(bid)
         return shared, len(shared) * self.block_size

@@ -332,6 +332,10 @@ class Scheduler:
             # A migrating request arrives with generated tokens, so the
             # copy-on-write cap widens to every full prompt block — the
             # token it feeds next is a generated one.
+            # prefix_lookup hands back blocks already retained on our
+            # behalf — the reference transfers to the slot table below
+            # (release/shrink drop it), or is freed when the budget
+            # check leaves the request waiting.
             shared, matched = self.cache.prefix_lookup(
                 req.prompt, digests=req._prompt_digests,
                 context_len=ctx if mig is not None else None)
@@ -340,6 +344,8 @@ class Scheduler:
                 self.cache.max_blocks)
             need = budget_blocks - len(shared)
             if need + self.watermark > self.cache.available_blocks:
+                if shared:
+                    self.cache.pool.free(shared)
                 break
             # Only the context's blocks are allocated now; the reserve
             # margin just gates admission (growth stays just-in-time).
@@ -347,8 +353,6 @@ class Scheduler:
             self.waiting.popleft()
             fresh = self.cache.alloc_blocks(need)
             assert fresh is not None  # guarded by the budget check
-            if shared:
-                self.cache.pool.retain(shared)
             slot = self._free_slots.pop(0)
             self.cache.assign(slot, shared + fresh)
             if mig is not None:

@@ -257,16 +257,21 @@ class WorkerServer:
                     "load": self._load()}
         if method == "kv_put":
             store = self.engine.kv_store
-            if store is None:
-                raise ValueError("kv_put: this worker has no kv store")
             frames = msg.get("_frames") or ()
             if not frames:
                 raise ValueError("kv_put without a payload frame")
-            stored = store.put(bytes.fromhex(msg["digest"]),
-                               decode_kv_block(frames[0]))
+            if store is None:
+                # Fleet-config state, not a protocol error: a worker
+                # without a local store just recomputes what the push
+                # would have saved.
+                return {"stored": False, "load": self._load()}
             # A pushed block is not "new" to the fleet — the front-end
-            # already knows it; don't echo it back through the catalog.
-            store.drain_new_digests()
+            # already knows it; announce=False keeps it out of the
+            # catalog feed without dropping the engine's OWN pending
+            # announcements.
+            stored = store.put(bytes.fromhex(msg["digest"]),
+                               decode_kv_block(frames[0]),
+                               announce=False)
             return {"stored": bool(stored), "load": self._load()}
         if method == "kv_get":
             store = self.engine.kv_store
